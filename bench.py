@@ -1,0 +1,46 @@
+"""Headline benchmark: BLS signature sets verified per second per chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+North star (BASELINE.md): verify all signatures of a full mainnet block
+(~128 sets) against a ~500k-validator state in <50 ms on one host — >=10x
+the reference's blst CPU path. ``vs_baseline`` is measured speedup of the
+TPU batch-verify dispatch over the same workload on this host's CPU
+single-set path (the stand-in for the blst-native worker pool baseline,
+reference: packages/beacon-node/src/chain/bls/multithread/index.ts).
+
+Round 1: the JAX BLS core is under construction; until the pairing kernel
+lands this reports the pure-Python single-set verify rate as the baseline
+placeholder with vs_baseline=1.0 so the driver has a stable metric line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def bench_placeholder() -> dict:
+    import hashlib
+
+    t0 = time.perf_counter()
+    n = 0
+    while time.perf_counter() - t0 < 0.5:
+        hashlib.sha256(b"x" * 1024).digest()
+        n += 1
+    elapsed = time.perf_counter() - t0
+    return {
+        "metric": "placeholder_sha256_ops_per_s",
+        "value": round(n / elapsed, 2),
+        "unit": "ops/s",
+        "vs_baseline": 1.0,
+    }
+
+
+def main() -> None:
+    print(json.dumps(bench_placeholder()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,32 @@
+"""lodestar_tpu — a TPU-native Ethereum consensus framework.
+
+A from-scratch re-design of the capability surface of Lodestar (ChainSafe's
+TypeScript consensus client, surveyed in SURVEY.md) built TPU-first:
+
+- The batched BLS12-381 signature-verification hot path (the reference's
+  ``BlsMultiThreadWorkerPool``, packages/beacon-node/src/chain/bls) runs as
+  jax.vmap'd limb-arithmetic pairing kernels on TPU — thousands of signature
+  sets verified in one device dispatch.
+- State is columnar (flat arrays for balances / participation / shuffling
+  inputs) so epoch processing vectorizes, instead of the reference's
+  persistent-merkle-tree ViewDU objects.
+- Multi-chip scale-out goes through ``jax.sharding.Mesh`` + ``shard_map``
+  (ICI collectives), not worker_threads.
+
+Subpackage map (mirrors SURVEY.md §1's layer map):
+
+- ``params``    — spec constants & presets   (reference: packages/params)
+- ``config``    — runtime chain config        (reference: packages/config)
+- ``types``     — SSZ types per fork          (reference: packages/types)
+- ``ssz``       — SSZ codec + merkleization   (reference: @chainsafe/ssz)
+- ``crypto``    — BLS12-381: pure-Python ground truth + verifier interfaces
+- ``ops``       — JAX/Pallas kernels (limbed field arith, pairing, sha256)
+- ``parallel``  — mesh / sharding helpers (dp across signature sets, ICI)
+- ``state_transition`` — the spec STF        (reference: packages/state-transition)
+- ``fork_choice``      — proto-array LMD-GHOST (reference: packages/fork-choice)
+- ``chain``     — node orchestration          (reference: beacon-node/src/chain)
+- ``db``        — key-value store abstraction (reference: packages/db)
+- ``utils``     — logger, errors, bytes, queues (reference: packages/utils)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,30 @@
+"""Cross-cutting utilities.
+
+Reference: packages/utils (logger, LodestarError, bytes, sleep/retry) and
+packages/beacon-node/src/util/queue/itemQueue.ts (JobItemQueue).
+"""
+
+from .errors import LodestarError, ErrorAborted, TimeoutError_
+from .bytes import (
+    to_hex,
+    from_hex,
+    int_to_bytes,
+    bytes_to_int,
+    bytes32_equal,
+)
+from .queue import JobItemQueue, QueueError, QueueErrorCode, QueueType
+
+__all__ = [
+    "LodestarError",
+    "ErrorAborted",
+    "TimeoutError_",
+    "to_hex",
+    "from_hex",
+    "int_to_bytes",
+    "bytes_to_int",
+    "bytes32_equal",
+    "JobItemQueue",
+    "QueueError",
+    "QueueErrorCode",
+    "QueueType",
+]

@@ -1,0 +1,151 @@
+"""Bounded async job queue with FIFO/LIFO order and max concurrency.
+
+Reference: packages/beacon-node/src/util/queue/itemQueue.ts (JobItemQueue) and
+errors.ts (QueueError codes). Used by gossip validation, the block processor,
+and state regen. The TPU twist: queues are also the batch-accumulation point —
+``drain_batch`` lets a consumer pull up to N pending items in one go so they
+can be verified in a single TPU dispatch (the reference instead buffered
+32 sigs / 100 ms inside the BLS pool, chain/bls/multithread/index.ts:41-57).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import enum
+import time
+from typing import Any, Awaitable, Callable, Deque, Generic, List, Optional, Tuple, TypeVar
+
+from .errors import LodestarError
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+
+class QueueType(str, enum.Enum):
+    FIFO = "FIFO"
+    LIFO = "LIFO"
+
+
+class QueueErrorCode(str, enum.Enum):
+    QUEUE_ABORTED = "QUEUE_ABORTED"
+    QUEUE_MAX_LENGTH = "QUEUE_MAX_LENGTH"
+
+
+class QueueError(LodestarError):
+    def __init__(self, code: QueueErrorCode):
+        super().__init__({"code": code.value})
+
+
+class QueueMetrics:
+    """Counters a Metrics registry can scrape (reference: queue/options.ts)."""
+
+    def __init__(self) -> None:
+        self.length = 0
+        self.dropped_jobs = 0
+        self.total_jobs = 0
+        self.job_wait_seconds_sum = 0.0
+        self.job_run_seconds_sum = 0.0
+
+
+class JobItemQueue(Generic[T, R]):
+    def __init__(
+        self,
+        process_fn: Callable[[T], Awaitable[R]],
+        *,
+        max_length: int,
+        max_concurrency: int = 1,
+        queue_type: QueueType = QueueType.FIFO,
+    ):
+        self._process_fn = process_fn
+        self.max_length = max_length
+        self.max_concurrency = max_concurrency
+        self.queue_type = queue_type
+        self.metrics = QueueMetrics()
+        self._items: Deque[Tuple[T, "asyncio.Future[R]", float]] = collections.deque()
+        self._running = 0
+        self._aborted = False
+        # Strong refs: the event loop only weakly references tasks, and a
+        # collected job task would strand its future and leak _running.
+        self._tasks: set = set()
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    async def push(self, item: T) -> R:
+        """Enqueue and await the processed result.
+
+        On overflow: FIFO drops the new job, LIFO drops the oldest pending job
+        (same policy as itemQueue.ts:45-56).
+        """
+        if self._aborted:
+            raise QueueError(QueueErrorCode.QUEUE_ABORTED)
+
+        if len(self._items) + 1 > self.max_length:
+            self.metrics.dropped_jobs += 1
+            if self.queue_type == QueueType.LIFO and self._items:
+                _, dropped_fut, _ = self._items.popleft()
+                if not dropped_fut.done():
+                    dropped_fut.set_exception(QueueError(QueueErrorCode.QUEUE_MAX_LENGTH))
+            else:
+                raise QueueError(QueueErrorCode.QUEUE_MAX_LENGTH)
+
+        fut: "asyncio.Future[R]" = asyncio.get_running_loop().create_future()
+        self._items.append((item, fut, time.monotonic()))
+        self.metrics.length = len(self._items)
+        self._schedule()
+        return await fut
+
+    def drain_batch(self, max_items: int) -> List[Tuple[T, "asyncio.Future[R]"]]:
+        """Pull up to max_items pending jobs for external batch processing.
+
+        The caller becomes responsible for resolving the futures. This is the
+        TPU batch-accumulation seam.
+        """
+        out: List[Tuple[T, "asyncio.Future[R]"]] = []
+        while self._items and len(out) < max_items:
+            item, fut, t0 = self._pop()
+            if fut.done():  # pusher was cancelled; nothing to resolve
+                continue
+            self.metrics.job_wait_seconds_sum += time.monotonic() - t0
+            out.append((item, fut))
+        self.metrics.length = len(self._items)
+        return out
+
+    def abort(self) -> None:
+        self._aborted = True
+        while self._items:
+            _, fut, _ = self._items.popleft()
+            if not fut.done():
+                fut.set_exception(QueueError(QueueErrorCode.QUEUE_ABORTED))
+        self.metrics.length = 0
+
+    def _pop(self) -> Tuple[T, "asyncio.Future[R]", float]:
+        if self.queue_type == QueueType.LIFO:
+            return self._items.pop()
+        return self._items.popleft()
+
+    def _schedule(self) -> None:
+        while self._running < self.max_concurrency and self._items:
+            item, fut, t0 = self._pop()
+            self.metrics.length = len(self._items)
+            self._running += 1
+            task = asyncio.get_running_loop().create_task(self._run_one(item, fut, t0))
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+
+    async def _run_one(self, item: T, fut: "asyncio.Future[R]", t0: float) -> None:
+        t1 = time.monotonic()
+        self.metrics.job_wait_seconds_sum += t1 - t0
+        try:
+            result = await self._process_fn(item)
+            if not fut.done():
+                fut.set_result(result)
+        except Exception as e:  # noqa: BLE001 - propagate to the caller's future
+            if not fut.done():
+                fut.set_exception(e)
+        finally:
+            self.metrics.job_run_seconds_sum += time.monotonic() - t1
+            self.metrics.total_jobs += 1
+            self._running -= 1
+            self._schedule()

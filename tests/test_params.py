@@ -1,0 +1,55 @@
+from lodestar_tpu.params import MAINNET, MINIMAL, FAR_FUTURE_EPOCH, DOMAIN_BEACON_ATTESTER
+from lodestar_tpu.config import (
+    MAINNET_CHAIN_CONFIG,
+    MINIMAL_CHAIN_CONFIG,
+    ForkName,
+    ForkConfig,
+    create_beacon_config,
+)
+
+
+def test_mainnet_preset_values():
+    assert MAINNET.SLOTS_PER_EPOCH == 32
+    assert MAINNET.SHUFFLE_ROUND_COUNT == 90
+    assert MAINNET.MAX_VALIDATORS_PER_COMMITTEE == 2048
+    assert MAINNET.SYNC_COMMITTEE_SIZE == 512
+    assert MAINNET.MAX_EFFECTIVE_BALANCE == 32_000_000_000
+    assert MAINNET.SYNC_COMMITTEE_SUBNET_SIZE == 128
+
+
+def test_minimal_preset_values():
+    assert MINIMAL.SLOTS_PER_EPOCH == 8
+    assert MINIMAL.SHUFFLE_ROUND_COUNT == 10
+    assert MINIMAL.SYNC_COMMITTEE_SIZE == 32
+    assert MINIMAL.EPOCHS_PER_SLASHINGS_VECTOR == 64
+
+
+def test_constants():
+    assert FAR_FUTURE_EPOCH == 2**64 - 1
+    assert DOMAIN_BEACON_ATTESTER == bytes([1, 0, 0, 0])
+
+
+def test_fork_schedule_mainnet():
+    fc = ForkConfig(MAINNET_CHAIN_CONFIG)
+    assert fc.get_fork_info_at_epoch(0).name == ForkName.phase0
+    assert fc.get_fork_info_at_epoch(74239).name == ForkName.phase0
+    assert fc.get_fork_info_at_epoch(74240).name == ForkName.altair
+    assert fc.get_fork_version(74240) == bytes.fromhex("01000000")
+
+
+def test_fork_digest_roundtrip():
+    gvr = b"\x2a" * 32
+    bc = create_beacon_config(MINIMAL_CHAIN_CONFIG, gvr)
+    digest = bc.fork_name_to_digest(ForkName.altair)
+    assert len(digest) == 4
+    assert bc.digest_to_fork_name(digest) == ForkName.altair
+    # Different versions must give different digests
+    assert digest != bc.fork_name_to_digest(ForkName.phase0)
+
+
+def test_unscheduled_fork_never_selected():
+    from lodestar_tpu.config.chain_config import ChainConfig
+    from lodestar_tpu.params import FAR_FUTURE_EPOCH
+
+    fc = ForkConfig(ChainConfig(PRESET_BASE="mainnet"))  # altair/bellatrix unscheduled
+    assert fc.get_fork_info_at_epoch(FAR_FUTURE_EPOCH).name == ForkName.phase0

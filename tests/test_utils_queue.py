@@ -1,0 +1,115 @@
+import asyncio
+
+import pytest
+
+from lodestar_tpu.utils import JobItemQueue, QueueError, QueueType
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def test_fifo_order_and_results():
+    async def main():
+        order = []
+
+        async def process(x):
+            order.append(x)
+            return x * 2
+
+        q = JobItemQueue(process, max_length=10, max_concurrency=1)
+        results = await asyncio.gather(*(q.push(i) for i in range(5)))
+        assert results == [0, 2, 4, 6, 8]
+        assert order == [0, 1, 2, 3, 4]
+
+    run(main())
+
+
+def test_max_length_fifo_rejects_new():
+    async def main():
+        started = asyncio.Event()
+        release = asyncio.Event()
+
+        async def process(x):
+            started.set()
+            await release.wait()
+            return x
+
+        q = JobItemQueue(process, max_length=2, max_concurrency=1)
+        t1 = asyncio.create_task(q.push(1))
+        await started.wait()
+        t2 = asyncio.create_task(q.push(2))
+        t3 = asyncio.create_task(q.push(3))
+        await asyncio.sleep(0)
+        with pytest.raises(QueueError):
+            await q.push(4)
+        release.set()
+        assert await asyncio.gather(t1, t2, t3) == [1, 2, 3]
+        assert q.metrics.dropped_jobs == 1
+
+    run(main())
+
+
+def test_lifo_processes_newest_first():
+    async def main():
+        order = []
+        started = asyncio.Event()
+        release = asyncio.Event()
+
+        async def process(x):
+            if x == 0:
+                started.set()
+                await release.wait()
+            order.append(x)
+            return x
+
+        q = JobItemQueue(process, max_length=10, max_concurrency=1, queue_type=QueueType.LIFO)
+        tasks = [asyncio.create_task(q.push(0))]
+        await started.wait()
+        tasks += [asyncio.create_task(q.push(i)) for i in (1, 2, 3)]
+        await asyncio.sleep(0)
+        release.set()
+        await asyncio.gather(*tasks)
+        assert order == [0, 3, 2, 1]
+
+    run(main())
+
+
+def test_abort_rejects_pending():
+    async def main():
+        release = asyncio.Event()
+
+        async def process(x):
+            await release.wait()
+            return x
+
+        q = JobItemQueue(process, max_length=10, max_concurrency=1)
+        t1 = asyncio.create_task(q.push(1))
+        t2 = asyncio.create_task(q.push(2))
+        await asyncio.sleep(0)
+        q.abort()
+        release.set()
+        await t1  # running job completes
+        with pytest.raises(QueueError):
+            await t2  # pending job aborted
+
+    run(main())
+
+
+def test_drain_batch():
+    async def main():
+        async def process(x):
+            return x
+
+        q = JobItemQueue(process, max_length=100, max_concurrency=0)  # never auto-runs
+        tasks = [asyncio.create_task(q.push(i)) for i in range(5)]
+        await asyncio.sleep(0)
+        batch = q.drain_batch(3)
+        assert [item for item, _ in batch] == [0, 1, 2]
+        for item, fut in batch:
+            fut.set_result(item + 100)
+        assert await asyncio.gather(*tasks[:3]) == [100, 101, 102]
+        for t in tasks[3:]:
+            t.cancel()
+
+    run(main())
