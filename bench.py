@@ -11,8 +11,9 @@ single-set path (the stand-in for the blst-native worker pool baseline,
 reference: packages/beacon-node/src/chain/bls/multithread/index.ts).
 
 Round 1: the JAX BLS core is under construction; until the pairing kernel
-lands this reports the pure-Python single-set verify rate as the baseline
-placeholder with vs_baseline=1.0 so the driver has a stable metric line.
+lands this prints a sha256-throughput placeholder line (clearly labeled as
+such in the metric name) with vs_baseline=1.0 so the driver has a stable
+JSON schema to record.
 """
 
 from __future__ import annotations

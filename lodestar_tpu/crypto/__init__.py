@@ -1,0 +1,5 @@
+"""Cryptography: BLS12-381 (ground-truth Python + TPU-backed verifiers), sha256 helpers.
+
+Reference equivalents: @chainsafe/blst (C+asm), @chainsafe/bls facade,
+herumi bls-eth-wasm fallback (SURVEY.md §2.9).
+"""

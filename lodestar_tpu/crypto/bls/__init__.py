@@ -1,0 +1,48 @@
+"""BLS12-381 for the ETH2 proof-of-possession ciphersuite.
+
+Ground-truth Python implementation (fields/curve/pairing/hash_to_curve/api)
+plus the IBlsVerifier plugin boundary (verifier). The TPU-backed verifier
+lives in lodestar_tpu.ops and is differential-tested against this package.
+"""
+
+from .api import (
+    PublicKey,
+    SecretKey,
+    Signature,
+    aggregate_pubkeys,
+    aggregate_signatures,
+    aggregate_verify,
+    fast_aggregate_verify,
+    interop_pubkeys,
+    interop_secret_key,
+    verify,
+    verify_multiple_signatures,
+)
+from .verifier import (
+    AggregatedSignatureSet,
+    IBlsVerifier,
+    PyBlsVerifier,
+    SignatureSet,
+    SingleSignatureSet,
+    get_aggregated_pubkey,
+)
+
+__all__ = [
+    "PublicKey",
+    "SecretKey",
+    "Signature",
+    "aggregate_pubkeys",
+    "aggregate_signatures",
+    "aggregate_verify",
+    "fast_aggregate_verify",
+    "interop_pubkeys",
+    "interop_secret_key",
+    "verify",
+    "verify_multiple_signatures",
+    "AggregatedSignatureSet",
+    "IBlsVerifier",
+    "PyBlsVerifier",
+    "SignatureSet",
+    "SingleSignatureSet",
+    "get_aggregated_pubkey",
+]

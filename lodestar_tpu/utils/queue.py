@@ -129,6 +129,8 @@ class JobItemQueue(Generic[T, R]):
         while self._running < self.max_concurrency and self._items:
             item, fut, t0 = self._pop()
             self.metrics.length = len(self._items)
+            if fut.done():  # pusher was cancelled; don't waste the slot
+                continue
             self._running += 1
             task = asyncio.get_running_loop().create_task(self._run_one(item, fut, t0))
             self._tasks.add(task)

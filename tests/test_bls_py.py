@@ -1,0 +1,279 @@
+"""Ground-truth BLS12-381 tests.
+
+Validation strategy (no spec-test vectors available offline):
+1. Algebraic identities: generator orders, bilinearity, non-degeneracy.
+2. Differential fixture: interop pubkeys vs the reference repo's cached
+   interop-pubkeys.json (real @chainsafe/blst output) — pins down Fq
+   arithmetic, G1 scalar mult, and ZCash compression bit-exactly.
+3. Round trips and negative cases for every API.
+"""
+
+import json
+import os
+
+import pytest
+
+from lodestar_tpu.crypto.bls import (
+    PublicKey,
+    SecretKey,
+    Signature,
+    aggregate_signatures,
+    aggregate_verify,
+    fast_aggregate_verify,
+    interop_pubkeys,
+    interop_secret_key,
+    verify,
+    verify_multiple_signatures,
+    PyBlsVerifier,
+    SingleSignatureSet,
+    AggregatedSignatureSet,
+)
+from lodestar_tpu.crypto.bls.curve import (
+    G1_GEN,
+    G2_GEN,
+    g1_from_bytes,
+    g1_subgroup_check,
+    g1_to_bytes,
+    g2_from_bytes,
+    g2_subgroup_check,
+    g2_to_bytes,
+    psi,
+    Point,
+    B1,
+    B2,
+)
+from lodestar_tpu.crypto.bls.fields import BLS_X, Fq2, Fq12, P, R
+from lodestar_tpu.crypto.bls.hash_to_curve import expand_message_xmd, hash_to_g2
+from lodestar_tpu.crypto.bls.pairing import pairing, multi_pairing
+
+INTEROP_PUBKEYS_PATH = "/root/reference/packages/state-transition/test-cache/interop-pubkeys.json"
+
+MSG = b"\xab" * 32
+
+
+class TestFields:
+    def test_fq2_inverse(self):
+        a = Fq2(123456789, 987654321)
+        assert a * a.inv() == Fq2.one()
+
+    def test_fq2_sqrt_roundtrip(self):
+        a = Fq2(1234, 5678)
+        sq = a.square()
+        root = sq.sqrt()
+        assert root is not None
+        assert root.square() == sq
+
+    def test_fq2_frobenius_is_pth_power(self):
+        a = Fq2(31415, 92653)
+        assert a.frobenius() == a.pow(P)
+
+    def test_fq12_inverse(self):
+        from lodestar_tpu.crypto.bls.fields import Fq6
+
+        x = Fq12(
+            Fq6(Fq2(1, 2), Fq2(3, 4), Fq2(5, 6)),
+            Fq6(Fq2(7, 8), Fq2(9, 10), Fq2(11, 12)),
+        )
+        assert x * x.inv() == Fq12.one()
+
+    def test_fq12_frobenius_is_pth_power(self):
+        from lodestar_tpu.crypto.bls.fields import Fq6
+
+        x = Fq12(
+            Fq6(Fq2(1, 2), Fq2(3, 4), Fq2(5, 6)),
+            Fq6(Fq2(7, 8), Fq2(9, 10), Fq2(11, 12)),
+        )
+        assert x.frobenius() == x.pow(P)
+
+
+class TestCurve:
+    def test_generators(self):
+        assert G1_GEN.is_on_curve()
+        assert G2_GEN.is_on_curve()
+        assert (G1_GEN * R).is_infinity()
+        assert (G2_GEN * R).is_infinity()
+
+    def test_subgroup_checks(self):
+        assert g1_subgroup_check(G1_GEN)
+        assert g2_subgroup_check(G2_GEN)
+        assert g1_subgroup_check(G1_GEN * 7)
+        assert g2_subgroup_check(G2_GEN * 7)
+
+    def test_psi_eigenvalue(self):
+        # psi acts as multiplication by z on G2
+        q = G2_GEN * 987654321
+        assert psi(q) == q * BLS_X
+
+    def test_g2_point_not_in_subgroup_detected(self):
+        # find a curve point NOT in G2 (E2 has large cofactor, so a random
+        # curve point is essentially never in the subgroup)
+        x = Fq2(1, 1)
+        while True:
+            y2 = x.square() * x + B2
+            y = y2.sqrt()
+            if y is not None:
+                pt = Point.from_affine(x, y, B2)
+                break
+            x = x + Fq2.one()
+        assert pt.is_on_curve()
+        assert not g2_subgroup_check(pt)
+
+    def test_serialization_roundtrip(self):
+        for k in (1, 2, 0xDEADBEEF):
+            p1 = G1_GEN * k
+            assert g1_from_bytes(g1_to_bytes(p1)) == p1
+            p2 = G2_GEN * k
+            assert g2_from_bytes(g2_to_bytes(p2)) == p2
+
+    def test_infinity_serialization(self):
+        inf1 = Point.infinity(B1)
+        assert g1_to_bytes(inf1)[0] == 0xC0
+        assert g1_from_bytes(g1_to_bytes(inf1)).is_infinity()
+        inf2 = Point.infinity(B2)
+        assert g2_from_bytes(g2_to_bytes(inf2)).is_infinity()
+
+    def test_malformed_rejected(self):
+        with pytest.raises(ValueError):
+            g1_from_bytes(b"\x00" * 48)  # no compression flag
+        with pytest.raises(ValueError):
+            g1_from_bytes((P - 1).to_bytes(48, "big"))  # x >= p after masking? flags
+        with pytest.raises(ValueError):
+            g2_from_bytes(b"\xc0" + b"\x01" * 95)  # dirty infinity
+
+
+class TestPairing:
+    def test_bilinearity(self):
+        e = pairing(G1_GEN, G2_GEN)
+        assert not e.is_one()
+        assert e.pow(R).is_one()
+        a, b = 654321, 123456
+        assert pairing(G1_GEN * a, G2_GEN * b) == e.pow(a * b)
+        assert pairing(G1_GEN * a, G2_GEN) == pairing(G1_GEN, G2_GEN * a)
+
+    def test_inverse_pair_cancels(self):
+        assert multi_pairing([(-G1_GEN, G2_GEN), (G1_GEN, G2_GEN)]).is_one()
+
+    def test_infinity_pairs_to_one(self):
+        assert pairing(Point.infinity(B1), G2_GEN).is_one()
+        assert pairing(G1_GEN, Point.infinity(B2)).is_one()
+
+
+class TestHashToCurve:
+    def test_expand_message_xmd_lengths(self):
+        out = expand_message_xmd(b"abc", b"DST", 256)
+        assert len(out) == 256
+        # deterministic
+        assert out == expand_message_xmd(b"abc", b"DST", 256)
+        assert out != expand_message_xmd(b"abd", b"DST", 256)
+
+    def test_hash_to_g2_in_subgroup(self):
+        for msg in (b"", b"abc", b"\x00" * 32):
+            pt = hash_to_g2(msg)
+            assert pt.is_on_curve()
+            assert g2_subgroup_check(pt)
+            assert not pt.is_infinity()
+
+    def test_hash_to_g2_deterministic_and_injective_ish(self):
+        assert hash_to_g2(b"m1") == hash_to_g2(b"m1")
+        assert hash_to_g2(b"m1") != hash_to_g2(b"m2")
+
+
+class TestInteropFixture:
+    @pytest.mark.skipif(
+        not os.path.exists(INTEROP_PUBKEYS_PATH), reason="reference fixture not mounted"
+    )
+    def test_interop_pubkeys_match_reference_blst_output(self):
+        ref = json.load(open(INTEROP_PUBKEYS_PATH))
+        mine = ["0x" + pk.hex() for pk in interop_pubkeys(8)]
+        assert mine == ref[:8]
+
+
+class TestSignatures:
+    def test_sign_verify(self):
+        sk = interop_secret_key(0)
+        pk = sk.to_public_key()
+        sig = sk.sign(MSG)
+        assert verify(pk, MSG, sig)
+        assert not verify(pk, b"\x01" * 32, sig)
+        assert not verify(interop_secret_key(1).to_public_key(), MSG, sig)
+
+    def test_serialization_roundtrip(self):
+        sk = interop_secret_key(2)
+        sig = sk.sign(MSG)
+        assert Signature.from_bytes(sig.to_bytes()) == sig
+        pk = sk.to_public_key()
+        assert PublicKey.from_bytes(pk.to_bytes()) == pk
+        assert SecretKey.from_bytes(sk.to_bytes()).value == sk.value
+
+    def test_fast_aggregate_verify(self):
+        sks = [interop_secret_key(i) for i in range(4)]
+        pks = [s.to_public_key() for s in sks]
+        agg = aggregate_signatures([s.sign(MSG) for s in sks])
+        assert fast_aggregate_verify(pks, MSG, agg)
+        assert not fast_aggregate_verify(pks[:3], MSG, agg)
+        assert not fast_aggregate_verify([], MSG, agg)
+
+    def test_aggregate_verify_distinct_messages(self):
+        sks = [interop_secret_key(i) for i in range(3)]
+        pks = [s.to_public_key() for s in sks]
+        msgs = [bytes([i]) * 32 for i in range(3)]
+        agg = aggregate_signatures([s.sign(m) for s, m in zip(sks, msgs)])
+        assert aggregate_verify(pks, msgs, agg)
+        assert not aggregate_verify(pks, msgs[::-1], agg)
+
+    def test_batch_verify(self):
+        sks = [interop_secret_key(i) for i in range(3)]
+        sets = []
+        for i, sk in enumerate(sks):
+            msg = bytes([i]) * 32
+            sets.append((sk.to_public_key(), msg, sk.sign(msg)))
+        assert verify_multiple_signatures(sets)
+        bad = list(sets)
+        bad[1] = (sets[1][0], sets[1][1], sks[2].sign(sets[1][1]))
+        assert not verify_multiple_signatures(bad)
+        assert not verify_multiple_signatures([])
+
+
+class TestVerifierBoundary:
+    def _sets(self):
+        out = []
+        for i in range(3):
+            sk = interop_secret_key(i)
+            msg = bytes([i]) * 32
+            out.append(
+                SingleSignatureSet(
+                    pubkey=sk.to_public_key(),
+                    signing_root=msg,
+                    signature=sk.sign(msg).to_bytes(),
+                )
+            )
+        return out
+
+    def test_verify_signature_sets(self):
+        v = PyBlsVerifier()
+        assert v.verify_signature_sets(self._sets())
+        assert v.batch_retries == 0
+
+    def test_batch_failure_retries_individually(self):
+        v = PyBlsVerifier()
+        sets = self._sets()
+        sets[1].signature = interop_secret_key(9).sign(sets[1].signing_root).to_bytes()
+        assert not v.verify_signature_sets(sets)
+        assert v.batch_retries == 1
+
+    def test_aggregated_set(self):
+        sks = [interop_secret_key(i) for i in range(4)]
+        agg = aggregate_signatures([s.sign(MSG) for s in sks])
+        s = AggregatedSignatureSet(
+            pubkeys=[s.to_public_key() for s in sks],
+            signing_root=MSG,
+            signature=agg.to_bytes(),
+        )
+        v = PyBlsVerifier()
+        assert v.verify_signature_sets([s])
+
+    def test_malformed_signature_bytes_rejected_not_raised(self):
+        v = PyBlsVerifier()
+        sets = self._sets()
+        sets[0].signature = b"\x00" * 96
+        assert not v.verify_signature_sets(sets)

@@ -6,7 +6,7 @@ from lodestar_tpu.utils import JobItemQueue, QueueError, QueueType
 
 
 def run(coro):
-    return asyncio.new_event_loop().run_until_complete(coro)
+    return asyncio.run(coro)
 
 
 def test_fifo_order_and_results():
