@@ -1,0 +1,26 @@
+"""TPU compute kernels (JAX/XLA) — the accelerator side of the framework.
+
+This package is the TPU-native replacement for the reference's native crypto
+worker pool (packages/beacon-node/src/chain/bls/multithread/index.ts:98 running
+supranational/blst C+asm in worker threads, SURVEY.md §2.9). Everything here is
+fixed-shape, branchless (select-based), batch-first JAX: one device dispatch
+verifies a whole batch of signature sets.
+
+Layering (bottom-up):
+- ``limbs``        Fq arithmetic over 16-bit limb arrays (uint32 lanes)
+- ``tower``        Fq2 / Fq6 / Fq12 extension towers as stacked limb arrays
+- ``points``       G1/G2 jacobian point kernels, endomorphisms, subgroup checks
+- ``pairing``      inversion-free Miller loop + final exponentiation
+- ``htc``          hash-to-G2 field/curve stages (host sha256 + device SSWU)
+- ``batch_verify`` the batched random-linear-combination verification kernel
+
+Ground truth for all of it is ``lodestar_tpu.crypto.bls`` (pure-Python bigint
+oracle); every kernel is differential-tested against it.
+
+No module in this package creates device arrays at import time: constants are
+kept as numpy arrays so importing (and tracing for an explicit CPU mesh) never
+touches the default JAX backend. This is what keeps the multi-chip CPU dryrun
+hermetic even when a TPU is visible but unusable.
+"""
+
+from . import limbs  # noqa: F401

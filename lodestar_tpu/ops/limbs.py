@@ -1,0 +1,362 @@
+"""Fq (BLS12-381 base field) arithmetic over 16-bit limb arrays — the TPU
+number system everything in ``lodestar_tpu.ops`` is built on.
+
+This replaces the reference's 384-bit assembly field arithmetic
+(supranational/blst, consumed via @chainsafe/blst — SURVEY.md §2.9) with a
+representation XLA can vectorize: an Fq element is a ``(..., 26)`` uint32
+array of base-2^16 digits (26*16 = 416 bits).  All operations broadcast over
+arbitrary leading axes, so "one element" and "a batch of thousands" run the
+same code — the tower/point/pairing layers exploit this by stacking their
+independent sub-multiplications into single calls (structure-of-arrays).
+
+Representation invariants
+-------------------------
+- *strict*  : every digit < 2^16 (so the value is < 2^416), value congruent
+  to the true residue mod p.  This is the storage format all functions
+  return unless documented otherwise.
+- *loose*   : digits may exceed 16 bits (bounds documented per function).
+  ``fp_add`` is lazy (returns loose) so addition chains cost nothing;
+  ``fp_strict`` re-normalizes.
+- Values are *redundant*: < 2^416, not < p.  Only ``fp_reduce_full`` (used
+  for equality / export) produces the canonical residue.
+
+Why 16-bit digits in uint32 lanes: TPUs have no native 64-bit multiplier;
+16x16->32 products are exact in uint32, and every carry/fold below is
+engineered so no intermediate exceeds 2^32.  No jax_enable_x64 dependency.
+
+All modulus-derived constants are *computed* at import from the Python
+bigint oracle (``lodestar_tpu.crypto.bls.fields``) — nothing is transcribed.
+Constants are numpy (never eager device arrays) so importing this module
+does not touch the default JAX backend — required for the hermetic CPU-mesh
+dryrun (see __graft_entry__.dryrun_multichip).
+
+Differential-tested against the oracle in tests/test_ops_limbs.py.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..crypto.bls.fields import P as P_INT
+
+LIMB_BITS = 16
+NLIMBS = 26  # 416 bits of headroom over the 381-bit modulus
+MASK = (1 << LIMB_BITS) - 1
+VALUE_BITS = LIMB_BITS * NLIMBS  # 416
+
+
+# ---------------------------------------------------------------------------
+# host-side packing helpers (numpy only)
+# ---------------------------------------------------------------------------
+
+
+def int_to_limbs(x: int, nlimbs: int = NLIMBS) -> np.ndarray:
+    """Python int -> (nlimbs,) uint32 base-2^16 digits (little-endian)."""
+    if x < 0:
+        raise ValueError("negative value")
+    out = np.zeros(nlimbs, dtype=np.uint32)
+    for i in range(nlimbs):
+        out[i] = x & MASK
+        x >>= LIMB_BITS
+    if x:
+        raise ValueError("value does not fit in limb array")
+    return out
+
+
+def limbs_to_int(a) -> int:
+    """(..., W) digit array (any radix-2^16 positional values) -> python int.
+    Accepts loose digits; accepts only a single element (no batch)."""
+    arr = np.asarray(a, dtype=np.uint64).reshape(-1)
+    total = 0
+    for i, d in enumerate(arr):
+        total += int(d) << (LIMB_BITS * i)
+    return total
+
+
+def ints_to_limbs(xs: Sequence[int]) -> np.ndarray:
+    """Batch pack: [int] -> (N, 26) uint32."""
+    return np.stack([int_to_limbs(x) for x in xs])
+
+
+# ---------------------------------------------------------------------------
+# modulus-derived constants (computed, not transcribed)
+# ---------------------------------------------------------------------------
+
+ZERO = int_to_limbs(0)
+ONE = int_to_limbs(1)
+P_LIMBS = int_to_limbs(P_INT)
+
+# 2^416 mod p — the top-carry fold constant
+R416 = int_to_limbs((1 << VALUE_BITS) % P_INT)
+
+# Fold table for products: RED[k] = 2^(16*(26+k)) mod p.  A 53-digit product
+# splits as low 26 digits + sum_k hi_k * RED[k].  28 rows covers any width
+# up to 54 digits.
+_RED_ROWS = 28
+RED = np.stack([int_to_limbs((1 << (LIMB_BITS * (NLIMBS + k))) % P_INT) for k in range(_RED_ROWS)])
+# 8-bit split of RED so fold products can be accumulated by an integer
+# einsum (dot) without exceeding uint32:  RED = RED_LO8 + 256 * RED_HI8.
+RED_LO8 = (RED & 0xFF).astype(np.uint32)
+RED_HI8 = (RED >> 8).astype(np.uint32)
+
+# Fold table toward 24 digits (full reduction): RED24[k] = 2^(16*(24+k)) mod p
+RED24 = np.stack([int_to_limbs((1 << (LIMB_BITS * (24 + k))) % P_INT) for k in range(3)])
+
+# Subtraction pad: a multiple of p >= 2^420 (covers loose subtrahends with
+# digits < 2^20), 27 digits.
+_PAD_INT = (((1 << 420) - 1) // P_INT + 1) * P_INT
+SUB_PAD = int_to_limbs(_PAD_INT, 27)
+
+# Conditional-subtract ladder for full reduction: 8p, 4p, 2p, p (all < 2^384)
+KP_LADDER = np.stack([int_to_limbs(k * P_INT) for k in (8, 4, 2, 1)])
+
+# One-hot column-selection tensor for the schoolbook product:
+# SEL[i, j, m] = 1 iff i + j == m.  einsum('...ij,ijm->...m') sums each
+# anti-diagonal; with 16-bit-split partial products every output stays
+# far below 2^32.
+_PROD_W = 2 * NLIMBS + 1  # 53
+SEL = np.zeros((NLIMBS, NLIMBS, _PROD_W), dtype=np.uint32)
+for _i in range(NLIMBS):
+    for _j in range(NLIMBS):
+        SEL[_i, _j, _i + _j] = 1
+
+
+# ---------------------------------------------------------------------------
+# carries and normalization
+# ---------------------------------------------------------------------------
+
+
+def _carry_u(x: jnp.ndarray) -> jnp.ndarray:
+    """Exact unsigned carry propagation.
+
+    x: (..., W) uint32 digits, each < 2^31.  Returns (..., W+1) strict
+    digits (< 2^16) of the same value.  The appended final carry is < 2^16
+    (fixed point of c' = (2^31 + c) >> 16 is ~2^15).
+    """
+    w = x.shape[-1]
+    digits = []
+    carry = jnp.zeros(x.shape[:-1], dtype=jnp.uint32)
+    for i in range(w):
+        t = x[..., i] + carry
+        digits.append(t & MASK)
+        carry = t >> LIMB_BITS
+    digits.append(carry)
+    return jnp.stack(digits, axis=-1)
+
+
+def _carry_s(x: jnp.ndarray) -> jnp.ndarray:
+    """Exact signed carry propagation (for subtraction).
+
+    x: (..., W) int32 digits in (-2^30, 2^30), total value known
+    non-negative.  Returns (..., W+1) strict uint32 digits.  The arithmetic
+    right shift floors toward -inf, so intermediate borrows are handled
+    branchlessly; the final carry is non-negative because the value is.
+    """
+    w = x.shape[-1]
+    digits = []
+    carry = jnp.zeros(x.shape[:-1], dtype=jnp.int32)
+    for i in range(w):
+        t = x[..., i] + carry
+        digits.append((t & MASK).astype(jnp.uint32))
+        carry = t >> LIMB_BITS
+    digits.append(carry.astype(jnp.uint32))
+    return jnp.stack(digits, axis=-1)
+
+
+def _finalize(x: jnp.ndarray) -> jnp.ndarray:
+    """Loose (..., W<=28) digits (< 2^31 each, value < 2^421) -> strict (..., 26).
+
+    One exact carry, then two top-fold rounds: value = low416 + top * 2^416
+    is replaced by low416 + top * (2^416 mod p).  Round 1 maps
+    v < 2^421 -> v' < 2^416 + 31p; round 2 maps that -> < 2^416.
+    The value bound < 2^421 means strict digits above index 26 are zero, so
+    digit 26 alone is the full top.
+    """
+    y = _carry_u(x)  # (..., W+1) strict; digits > 26 are 0 by the value bound
+    for _ in range(2):
+        top = y[..., NLIMBS]  # <= 31 by value bound
+        y = _carry_u(y[..., :NLIMBS] + top[..., None] * jnp.asarray(R416))
+    return y[..., :NLIMBS]
+
+
+def fp_strict(x: jnp.ndarray) -> jnp.ndarray:
+    """Re-normalize a loose element (digits < 2^31, value < 2^421)."""
+    if x.shape[-1] < NLIMBS:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, NLIMBS - x.shape[-1])])
+    return _finalize(x)
+
+
+# ---------------------------------------------------------------------------
+# ring operations
+# ---------------------------------------------------------------------------
+
+
+def fp_add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Lazy addition: digitwise sum, NO carry.  Each input may itself be
+    loose; the caller is responsible for keeping digits < 2^31 across a
+    chain (each add of strict values grows the bound by one bit) and calling
+    ``fp_strict`` before multiplication."""
+    return a + b
+
+
+def fp_sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b mod p, strict output.
+
+    Accepts loose inputs: a digits < 2^29, b digits < 2^20 (value(b) <
+    2^420 <= SUB_PAD).  Computed as a + SUB_PAD - b with signed carries.
+    """
+    wa, wb = a.shape[-1], b.shape[-1]
+    w = max(wa, wb, 27)
+    pad_a = [(0, 0)] * (a.ndim - 1) + [(0, w - wa)]
+    pad_b = [(0, 0)] * (b.ndim - 1) + [(0, w - wb)]
+    ai = jnp.pad(a, pad_a).astype(jnp.int32)
+    bi = jnp.pad(b, pad_b).astype(jnp.int32)
+    pad_c = np.zeros(w, dtype=np.int32)
+    pad_c[:27] = SUB_PAD.astype(np.int32)
+    d = ai + jnp.asarray(pad_c) - bi
+    return _finalize(_carry_s(d)[..., : w + 1])
+
+
+def fp_neg(a: jnp.ndarray) -> jnp.ndarray:
+    """-a mod p (strict). Accepts loose a with digits < 2^20."""
+    return fp_sub(jnp.zeros((1,), dtype=jnp.uint32), a)
+
+
+def fp_mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """a * k for a small non-negative python int k < 2^14; a strict."""
+    if not 0 <= k < (1 << 14):
+        raise ValueError("small multiplier out of range")
+    return _finalize(a * jnp.uint32(k))
+
+
+def fp_mul(a: jnp.ndarray, b: jnp.ndarray, *, a_strict: bool = True, b_strict: bool = True) -> jnp.ndarray:
+    """a * b mod p -> strict (..., 26).
+
+    Inputs must be strict (digits < 2^16); pass ``a_strict=False`` /
+    ``b_strict=False`` to have them re-normalized here.  Schoolbook
+    26x26 digit products, 16-bit-split and summed along anti-diagonals by an
+    integer einsum (an MXU-shaped contraction), then folded below 2^416 via
+    the RED table.
+    """
+    if not a_strict:
+        a = fp_strict(a)
+    if not b_strict:
+        b = fp_strict(b)
+    prod = a[..., :, None] * b[..., None, :]  # (..., 26, 26) u32, exact
+    lo = prod & MASK
+    hi = prod >> LIMB_BITS
+    sel = jnp.asarray(SEL)
+    # anti-diagonal sums: <= 26 terms of < 2^16 each -> < 2^21
+    z_lo = jnp.einsum("...ij,ijm->...m", lo, sel)
+    z_hi = jnp.einsum("...ij,ijm->...m", hi, sel)
+    z = jnp.pad(z_lo, [(0, 0)] * (z_lo.ndim - 1) + [(0, 1)])
+    z = z.at[..., 1:].add(z_hi)  # (..., 54) digits < 2^22
+    z = _carry_u(z)  # (..., 55) strict; digits beyond 53 are zero by value
+    # fold: value = low26 + sum_k hi_k * RED[k]
+    hi_digits = z[..., NLIMBS : NLIMBS + _RED_ROWS]  # (..., 28) strict
+    e_lo = jnp.einsum("...k,kj->...j", hi_digits, jnp.asarray(RED_LO8))  # < 28*2^24 < 2^29
+    e_hi = jnp.einsum("...k,kj->...j", hi_digits, jnp.asarray(RED_HI8))
+    out = jnp.pad(z[..., :NLIMBS], [(0, 0)] * (z.ndim - 1) + [(0, 1)])
+    out = out.at[..., :NLIMBS].add(e_lo + ((e_hi & 0xFF) << 8))
+    out = out.at[..., 1 : NLIMBS + 1].add(e_hi >> 8)
+    # out: (..., 27) digits < 2^31, value < 2^416 + 28*2^16*p < 2^421
+    return _finalize(out)
+
+
+def fp_sqr(a: jnp.ndarray, *, a_strict: bool = True) -> jnp.ndarray:
+    return fp_mul(a, a, a_strict=a_strict, b_strict=a_strict)
+
+
+def fp_select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """where(cond, a, b) with cond broadcast over the limb axis."""
+    return jnp.where(cond[..., None], a, b)
+
+
+# ---------------------------------------------------------------------------
+# full reduction, comparison, inversion
+# ---------------------------------------------------------------------------
+
+
+def _cond_sub(a: jnp.ndarray, c: np.ndarray) -> jnp.ndarray:
+    """a - c if a >= c else a, both strict 26-digit, c a numpy constant."""
+    d = a.astype(jnp.int32) - jnp.asarray(np.pad(c, (0, NLIMBS - len(c))).astype(np.int32))
+    w = d.shape[-1]
+    digits = []
+    carry = jnp.zeros(d.shape[:-1], dtype=jnp.int32)
+    for i in range(w):
+        t = d[..., i] + carry
+        digits.append((t & MASK).astype(jnp.uint32))
+        carry = t >> LIMB_BITS
+    sub = jnp.stack(digits, axis=-1)
+    return jnp.where((carry >= 0)[..., None], sub, a)
+
+
+def fp_reduce_full(a: jnp.ndarray) -> jnp.ndarray:
+    """Strict redundant (< 2^416) -> canonical residue < p (top digits 0).
+
+    Folds digits 24..25 through RED24 until the value is < 2^384 (the
+    fold contracts the overflow by ~2^-3 per round; 9 rounds guarantee a
+    {0,1} top which one more fold clears), then a 8p/4p/2p/p conditional-
+    subtract ladder lands in [0, p).
+    """
+    x = a
+    for _ in range(10):
+        hi0 = x[..., 24]
+        hi1 = x[..., 25]
+        base = jnp.pad(x[..., :24], [(0, 0)] * (x.ndim - 1) + [(0, 2)])
+        p0 = hi0[..., None] * jnp.asarray(RED24[0])  # (..., 26) products < 2^32
+        p1 = hi1[..., None] * jnp.asarray(RED24[1])
+        acc = base
+        for prod in (p0, p1):
+            acc = acc.at[..., :NLIMBS].add(prod & MASK)
+            acc = acc.at[..., 1:].add((prod >> LIMB_BITS)[..., :-1])
+            # RED24 rows are < 2^381 so product digit 25's high half is 0
+        x = _carry_u(acc)[..., :NLIMBS]
+    for row in KP_LADDER:
+        x = _cond_sub(x, row)
+    return x
+
+
+def fp_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Value equality mod p (strict inputs); returns bool (...)."""
+    return jnp.all(fp_reduce_full(a) == fp_reduce_full(b), axis=-1)
+
+
+def fp_is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(fp_reduce_full(a) == 0, axis=-1)
+
+
+def _exp_bits(e: int) -> np.ndarray:
+    """MSB-first bit array of a positive exponent."""
+    bits = bin(e)[2:]
+    return np.array([int(c) for c in bits], dtype=np.uint32)
+
+
+def fp_pow_static(a: jnp.ndarray, e: int) -> jnp.ndarray:
+    """a^e for a static python-int exponent, via lax.scan square-and-multiply
+    (graph size O(1) in the exponent length)."""
+    if e < 0:
+        raise ValueError("negative exponent")
+    if e == 0:
+        return jnp.broadcast_to(jnp.asarray(ONE), a.shape).astype(jnp.uint32)
+    bits = jnp.asarray(_exp_bits(e))
+
+    def body(r, bit):
+        r = fp_sqr(r)
+        r = fp_select(bit.astype(bool), fp_mul(r, a), r)
+        return r, None
+
+    init = jnp.broadcast_to(jnp.asarray(ONE), a.shape).astype(jnp.uint32)
+    # first bit is always 1: start from ONE and scan all bits
+    out, _ = lax.scan(body, init, bits)
+    return out
+
+
+def fp_inv(a: jnp.ndarray) -> jnp.ndarray:
+    """Multiplicative inverse via Fermat (a^(p-2)); a=0 -> 0."""
+    return fp_pow_static(a, P_INT - 2)
