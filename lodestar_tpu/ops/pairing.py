@@ -1,0 +1,210 @@
+"""Optimal ate pairing kernels: batched Miller loop + shared final exponentiation.
+
+The TPU replacement for blst's pairing core (the compute inside the
+reference's worker pool, chain/bls/multithread/worker.ts ->
+bls.Signature.verifyMultipleSignatures).  Differences from the oracle
+(crypto/bls/pairing.py) are all about machine shape, not math:
+
+- Jacobian, inversion-free Miller loop.  The oracle uses affine slopes with
+  a field inversion per step; here each line value is scaled by the slope
+  denominator (an Fq2 element).  Subfield factors are killed by the easy
+  part of the final exponentiation (for a in Fq2, a^(p^6-1) = 1 since
+  (p^2-1) | (p^6-1)), so the pairing value is unchanged.
+- lax.scan over the 63 post-leading bits of |BLS_X| with a lax.cond addition
+  step (6 set bits): graph size is one loop body, runtime only pays the add
+  step when the static bit is set.
+- Final exponentiation: easy part structurally (conj * inv, frobenius), hard
+  part by square-and-multiply scan over the bits of the *computed* exponent
+  (p^4 - p^2 + 1) // r.  Batch verification calls it once per batch on the
+  product of Miller values (multi_pairing semantics of the oracle).
+
+All leading axes broadcast; miller_loop over a (N, ...) batch of pairs is
+one vectorized program.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..crypto.bls.fields import BLS_X, P as P_INT, R as R_INT
+from . import limbs as fl
+from . import tower as tw
+from .limbs import fp_add, fp_strict, fp_sub
+from .points import FQ2_NS, Point
+
+# bits of |BLS_X| after the leading 1, MSB first (static: 63 entries, 5 set)
+_X_BITS = np.array([int(c) for c in bin(abs(BLS_X))[3:]], dtype=np.uint32)
+
+# hard-part exponent, computed not transcribed
+_HARD_EXP = (P_INT**4 - P_INT**2 + 1) // R_INT
+
+
+def _line_to_fq12(c0, c1, c2):
+    """Assemble the sparse line value  (c0 + c1 v) + (c2 v) w  as a full
+    Fq12 array (c0, c1, c2: (..., 2, 26) Fq2).  Mirrors oracle _line()."""
+    zero = jnp.zeros_like(c0)
+    six0 = jnp.stack([c0, c1, zero], axis=-3)
+    six1 = jnp.stack([zero, c2, zero], axis=-3)
+    return jnp.stack([six0, six1], axis=-4)
+
+
+def _dbl_step(t: Point, xp, yp):
+    """Tangent-line doubling step.
+
+    t: jacobian Fq2 point (X, Y, Z); xp, yp: affine Fq coords of the G1
+    argument.  Returns (t2, line) with line scaled by 2YZ^3 (in Fq2).
+
+      lam = 3X^2/(2YZ);  line * 2YZ^3:
+        c0 = 3X^3 - 2Y^2
+        c1 = -3X^2 Z^2 * xp
+        c2 = 2YZ^3 * yp
+    """
+    x, y, z = t
+    m1 = tw.fq2_mul_many(jnp.stack([x, y, z, y], axis=-3), jnp.stack([x, y, z, z], axis=-3))
+    x2, y2, z2, yz = (m1[..., i, :, :] for i in range(4))
+    x2_3 = fp_strict(fp_add(fp_add(x2, x2), x2))  # 3X^2
+    m2 = tw.fq2_mul_many(
+        jnp.stack([x2_3, x2_3, yz], axis=-3),
+        jnp.stack([x, z2, z2], axis=-3),
+    )
+    x3_3, c1_raw, yz3 = (m2[..., i, :, :] for i in range(3))  # 3X^3, 3X^2 Z^2, YZ^3
+    c0 = fp_sub(x3_3, fp_add(y2, y2))
+    c1 = tw.fq2_scale_fq(c1_raw, xp)
+    c1 = jnp.stack([fl.fp_neg(c1[..., 0, :]), fl.fp_neg(c1[..., 1, :])], axis=-2)
+    yz3_2 = fp_strict(fp_add(yz3, yz3))
+    c2 = tw.fq2_scale_fq(yz3_2, yp)
+    # T = 2T, sharing nothing for now (correctness first)
+    from .points import point_double
+
+    t2 = point_double(t, FQ2_NS)
+    return t2, _line_to_fq12(c0, c1, c2)
+
+
+def _add_step(t: Point, xq, yq, xp, yp):
+    """Addition step with the affine loop point Q = (xq, yq).
+
+    Line through T and Q evaluated at P, scaled by Z*H (Fq2):
+      theta = Y - yq Z^3,  H = X - xq Z^2
+      c0 = theta xq - yq Z H
+      c1 = -theta xp
+      c2 = Z H yp
+    T' = T + Q (mixed jacobian add).
+    """
+    x, y, z = t
+    m1 = tw.fq2_mul_many(jnp.stack([z, z], axis=-3), jnp.stack([z, z], axis=-3))
+    zz = m1[..., 0, :, :]
+    m2 = tw.fq2_mul_many(jnp.stack([xq, zz], axis=-3), jnp.stack([zz, z], axis=-3))
+    u2, zzz = m2[..., 0, :, :], m2[..., 1, :, :]
+    m3 = tw.fq2_mul_many(jnp.stack([yq], axis=-3), jnp.stack([zzz], axis=-3))
+    s2 = m3[..., 0, :, :]
+    theta = fp_sub(y, s2)  # Y - yq Z^3
+    h = fp_sub(x, u2)  # X - xq Z^2
+    m4 = tw.fq2_mul_many(jnp.stack([z, theta], axis=-3), jnp.stack([h, xq], axis=-3))
+    zh, theta_xq = m4[..., 0, :, :], m4[..., 1, :, :]
+    m5 = tw.fq2_mul_many(jnp.stack([yq], axis=-3), jnp.stack([zh], axis=-3))
+    yq_zh = m5[..., 0, :, :]
+    c0 = fp_sub(theta_xq, yq_zh)
+    c1_raw = tw.fq2_scale_fq(theta, xp)
+    c1 = jnp.stack([fl.fp_neg(c1_raw[..., 0, :]), fl.fp_neg(c1_raw[..., 1, :])], axis=-2)
+    c2 = tw.fq2_scale_fq(zh, yp)
+    line = _line_to_fq12(c0, c1, c2)
+
+    # mixed add T + Q  (madd, h/r convention: H = U2 - X = -h, R = S2 - Y)
+    hm = fp_sub(u2, x)
+    rm = fp_strict(fp_add(fp_sub(s2, y), fp_sub(s2, y)))  # 2(S2 - Y)
+    m6 = tw.fq2_mul_many(jnp.stack([hm, rm], axis=-3), jnp.stack([hm, rm], axis=-3))
+    hh, r2 = m6[..., 0, :, :], m6[..., 1, :, :]
+    ii = fp_strict(fp_add(fp_add(hh, hh), fp_add(hh, hh)))  # 4 HH
+    m7 = tw.fq2_mul_many(jnp.stack([hm, x, z], axis=-3), jnp.stack([ii, ii, hm], axis=-3))
+    j, v, zh_m = m7[..., 0, :, :], m7[..., 1, :, :], m7[..., 2, :, :]
+    x3 = fp_sub(r2, fp_add(j, fp_add(v, v)))
+    m8 = tw.fq2_mul_many(
+        jnp.stack([rm, y], axis=-3),
+        jnp.stack([fp_sub(v, x3), j], axis=-3),
+    )
+    rvx, yj = m8[..., 0, :, :], m8[..., 1, :, :]
+    y3 = fp_sub(rvx, fp_strict(fp_add(yj, yj)))
+    z3 = fp_strict(fp_add(zh_m, zh_m))  # 2 Z H ... = (Z+H)^2 - ZZ - HH
+    return (x3, y3, z3), line
+
+
+def miller_loop(xp, yp, xq, yq):
+    """f_{|z|, Q}(P) conjugated for the negative BLS parameter.
+
+    xp, yp: (..., 26) Fq affine G1 coords; xq, yq: (..., 2, 26) Fq2 affine
+    coords of the (twist) G2 point.  Returns (..., 2, 3, 2, 26) Fq12.
+    Oracle: crypto/bls/pairing.py miller_loop.
+    """
+    f = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), xp.shape[:-1] + (2, 3, 2, fl.NLIMBS)).astype(jnp.uint32)
+    one = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE), xq.shape).astype(jnp.uint32)
+    t = (xq, yq, one)
+
+    def body(carry, bit):
+        f, t = carry
+        f = tw.fq12_sqr(f)
+        t, line = _dbl_step(t, xp, yp)
+        f = tw.fq12_mul(f, line)
+
+        def do_add(args):
+            f, t = args
+            t2, line2 = _add_step(t, xq, yq, xp, yp)
+            return tw.fq12_mul(f, line2), t2
+
+        f, t = lax.cond(bit.astype(bool), do_add, lambda args: args, (f, t))
+        return (f, t), None
+
+    (f, _), _ = lax.scan(body, (f, t), jnp.asarray(_X_BITS))
+    return tw.fq12_conj(f)
+
+
+def final_exponentiation(f):
+    """f^((p^12-1)/r).  Easy part structural; hard part is a scan over the
+    computed exponent bits.  Oracle: pairing.final_exponentiation."""
+    f1 = tw.fq12_mul(tw.fq12_conj(f), tw.fq12_inv(f))  # f^(p^6 - 1)
+    f2 = tw.fq12_mul(tw.fq12_frobenius(tw.fq12_frobenius(f1)), f1)  # ^(p^2 + 1)
+
+    bits = jnp.asarray(fl._exp_bits(_HARD_EXP))
+
+    def body(r, bit):
+        r = tw.fq12_sqr(r)
+        r = tw.fq12_select(bit.astype(bool), tw.fq12_mul(r, f2), r)
+        return r, None
+
+    init = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), f2.shape).astype(jnp.uint32)
+    out, _ = lax.scan(body, init, bits)
+    return out
+
+
+def pairing(xp, yp, xq, yq):
+    """e(P, Q) for affine inputs (no infinity handling — callers mask)."""
+    return final_exponentiation(miller_loop(xp, yp, xq, yq))
+
+
+def multi_miller_product(xp, yp, xq, yq, mask):
+    """prod_i f_i over the leading batch axis, with masked entries
+    contributing 1 — the multi_pairing structure (oracle multi_pairing):
+    one shared final exponentiation amortizes over the whole batch.
+
+    mask: (N,) bool — True = include this pair.
+    """
+    f = miller_loop(xp, yp, xq, yq)  # (N, ..., 2, 3, 2, 26)
+    one = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), f.shape).astype(jnp.uint32)
+    f = tw.fq12_select(mask, f, one)
+    # pairwise product tree over axis 0
+    while f.shape[0] > 1:
+        n = f.shape[0]
+        if n % 2:
+            pad = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), (1,) + f.shape[1:]).astype(jnp.uint32)
+            f = jnp.concatenate([f, pad])
+            n += 1
+        half = n // 2
+        f = tw.fq12_mul(f[:half], f[half:])
+    return f[0]
+
+
+def pairing_product_is_one(xp, yp, xq, yq, mask):
+    """The batch-verify verdict primitive: prod_i e(P_i, Q_i) == 1."""
+    return tw.fq12_is_one(final_exponentiation(multi_miller_product(xp, yp, xq, yq, mask)))

@@ -1,0 +1,382 @@
+"""G1/G2 jacobian point kernels over the limb fields — batched, branchless.
+
+Replaces the reference's blst point pipeline (aggregation in jacobian
+coordinates, packages/state-transition/src/cache/pubkeyCache.ts:75; scalar
+multiplication inside verifyMultipleSignatures) with select-based JAX code.
+
+A point is a ``(x, y, z)`` tuple of field arrays (Fq: (..., 26);
+Fq2: (..., 2, 26)), jacobian coordinates: affine = (X/Z^2, Y/Z^3).
+
+Infinity convention: a point is infinity iff its Z is the EXACT all-zero
+digit array.  In the redundant representation a cancellation (e.g.
+fp_sub(a, a)) yields a nonzero digit pattern congruent to 0 mod p, so exact
+zeros only arise where we construct them deliberately — which is precisely
+the accumulator-init / padding cases the select-based formulas must handle.
+
+Two addition flavors:
+- ``point_add_unsafe``: no equal/opposite handling.  Sound wherever the two
+  operands are independently randomized (RLC scalar multiples with fresh
+  64-bit coefficients — a collision implies a ~2^-64 coefficient collision,
+  mirroring the soundness bound of verifyMultipleSignatures itself,
+  chain/bls/maybeBatch.ts:17-27).
+- ``point_add_complete``: full select ladder (equal -> double, opposite ->
+  infinity).  Required for subgroup-check scalar mults where the adversary
+  chooses the point and can target small-order inputs.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..crypto.bls import curve as C
+from ..crypto.bls import fields as F
+from . import limbs as fl
+from . import tower as tw
+from .limbs import fp_add, fp_strict, fp_sub
+
+# ---------------------------------------------------------------------------
+# field namespaces: the generic point formulas below are written once and
+# instantiated for Fq (G1) and Fq2 (G2)
+# ---------------------------------------------------------------------------
+
+
+class FieldNS(NamedTuple):
+    comp_ndim: int  # trailing axes of one element: 1 for Fq, 2 for Fq2
+    mul_many: callable  # stacked independent products along axis -(comp_ndim+1)
+    inv: callable
+    is_zero_mod: callable  # zero as a residue (full reduction)
+    eq_mod: callable
+    zero_const: np.ndarray
+    one_const: np.ndarray
+
+    def stack(self, elems):
+        return jnp.stack(elems, axis=-(self.comp_ndim + 1))
+
+    def unstack(self, arr, k):
+        axis = arr.ndim - (self.comp_ndim + 1)
+        return tuple(jnp.take(arr, i, axis=axis) for i in range(k))
+
+    def mul(self, a, b):
+        return self.unstack(self.mul_many(self.stack([a]), self.stack([b])), 1)[0]
+
+    def select(self, cond, a, b):
+        c = cond.reshape(cond.shape + (1,) * self.comp_ndim)
+        return jnp.where(c, a, b)
+
+    def is_exact_zero(self, a):
+        axes = tuple(range(-self.comp_ndim, 0))
+        return jnp.all(a == 0, axis=axes)
+
+
+def _fq_mul_many(a, b):
+    return fl.fp_mul(a, b)
+
+
+def _fq_eq(a, b):
+    return fl.fp_eq(a, b)
+
+
+FQ_NS = FieldNS(
+    comp_ndim=1,
+    mul_many=_fq_mul_many,
+    inv=fl.fp_inv,
+    is_zero_mod=fl.fp_is_zero,
+    eq_mod=_fq_eq,
+    zero_const=fl.ZERO,
+    one_const=fl.ONE,
+)
+
+FQ2_NS = FieldNS(
+    comp_ndim=2,
+    mul_many=tw.fq2_mul_many,
+    inv=tw.fq2_inv,
+    is_zero_mod=tw.fq2_is_zero,
+    eq_mod=tw.fq2_eq,
+    zero_const=tw.FQ2_ZERO,
+    one_const=tw.FQ2_ONE,
+)
+
+# ---------------------------------------------------------------------------
+# constants (computed from the oracle)
+# ---------------------------------------------------------------------------
+
+# psi (untwist-Frobenius-twist) coefficients, from curve.py's computed values
+PSI_CX = tw.fq2_const(C.PSI_CX)
+PSI_CY = tw.fq2_const(C.PSI_CY)
+# G1 endomorphism sigma(x, y) = (beta x, y)
+BETA = fl.int_to_limbs(C.BETA)
+
+G1_GEN_AFFINE = (fl.int_to_limbs(C.G1_GEN.x.n), fl.int_to_limbs(C.G1_GEN.y.n))
+G1_GEN_NEG_AFFINE = (fl.int_to_limbs(C.G1_GEN.x.n), fl.int_to_limbs((-C.G1_GEN.y).n))
+G2_GEN_AFFINE = (tw.fq2_const(C.G2_GEN.x), tw.fq2_const(C.G2_GEN.y))
+
+Point = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]
+
+
+def point_infinity(ns: FieldNS, batch_shape=()) -> Point:
+    shape = batch_shape + ns.one_const.shape
+    one = jnp.broadcast_to(jnp.asarray(ns.one_const), shape).astype(jnp.uint32)
+    zero = jnp.zeros(shape, dtype=jnp.uint32)
+    return (one, one, zero)
+
+
+def point_from_affine(x: jnp.ndarray, y: jnp.ndarray, ns: FieldNS) -> Point:
+    z = jnp.broadcast_to(jnp.asarray(ns.one_const), x.shape).astype(jnp.uint32)
+    return (x, y, z)
+
+
+def point_is_infinity(p: Point, ns: FieldNS) -> jnp.ndarray:
+    return ns.is_exact_zero(p[2])
+
+
+def point_neg(p: Point, ns: FieldNS) -> Point:
+    return (p[0], fl.fp_neg(p[1]), p[2])
+
+
+def point_select(cond: jnp.ndarray, a: Point, b: Point, ns: FieldNS) -> Point:
+    return tuple(ns.select(cond, ai, bi) for ai, bi in zip(a, b))
+
+
+def point_double(p: Point, ns: FieldNS) -> Point:
+    """2P (jacobian).  Handles infinity and y=0 implicitly (z3 = 2yz = 0
+    exactly, because both cases carry exact-zero digits)."""
+    x, y, z = p
+    s1 = ns.mul_many(ns.stack([x, y, y]), ns.stack([x, y, z]))
+    a, bb, yz = ns.unstack(s1, 3)
+    e = fp_strict(fp_add(fp_add(a, a), a))  # 3x^2
+    xbb = fp_strict(fp_add(x, bb))
+    s2 = ns.mul_many(ns.stack([xbb, bb, e]), ns.stack([xbb, bb, e]))
+    xbb2, c, f = ns.unstack(s2, 3)
+    # d = 2((x+bb)^2 - a - c)
+    d_half = fp_sub(xbb2, fp_add(a, c))
+    d = fp_strict(fp_add(d_half, d_half))
+    x3 = fp_sub(f, fp_add(d, d))
+    c8 = fp_strict(fp_add(fp_add(fp_add(c, c), fp_add(c, c)), fp_add(fp_add(c, c), fp_add(c, c))))
+    s3 = ns.mul_many(ns.stack([e]), ns.stack([fp_sub(d, x3)]))
+    (ed,) = ns.unstack(s3, 1)
+    y3 = fp_sub(ed, c8)
+    z3 = fp_strict(fp_add(yz, yz))
+    return (x3, y3, z3)
+
+
+def _add_core(p: Point, q: Point, ns: FieldNS):
+    """Shared add machinery; returns (x3, y3, z3, h, sdiff)."""
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    s1 = ns.mul_many(ns.stack([z1, z2]), ns.stack([z1, z2]))
+    z1z1, z2z2 = ns.unstack(s1, 2)
+    s2 = ns.mul_many(
+        ns.stack([x1, x2, y1, y2]),
+        ns.stack([z2z2, z1z1, z2z2, z1z1]),
+    )
+    u1, u2, s1y, s2y = ns.unstack(s2, 4)
+    s3 = ns.mul_many(ns.stack([s1y, s2y]), ns.stack([z2, z1]))
+    s1f, s2f = ns.unstack(s3, 2)
+    h = fp_sub(u2, u1)
+    sdiff = fp_sub(s2f, s1f)
+    r = fp_strict(fp_add(sdiff, sdiff))
+    hh = fp_strict(fp_add(h, h))
+    zsum = fp_strict(fp_add(z1, z2))
+    s4 = ns.mul_many(ns.stack([hh, r, zsum]), ns.stack([hh, r, zsum]))
+    i, r2, zsum2 = ns.unstack(s4, 3)
+    s5 = ns.mul_many(ns.stack([h, u1]), ns.stack([i, i]))
+    j, v = ns.unstack(s5, 2)
+    x3 = fp_sub(r2, fp_add(j, fp_add(v, v)))
+    s6 = ns.mul_many(
+        ns.stack([r, s1f, fp_sub(zsum2, fp_add(z1z1, z2z2))]),
+        ns.stack([fp_sub(v, x3), j, h]),
+    )
+    rvx, s1j, z3 = ns.unstack(s6, 3)
+    y3 = fp_sub(rvx, fp_strict(fp_add(s1j, s1j)))
+    return x3, y3, z3, h, sdiff
+
+
+def point_add_unsafe(p: Point, q: Point, ns: FieldNS) -> Point:
+    """Jacobian add; correct when p != +-q (or either is infinity)."""
+    x3, y3, z3, _, _ = _add_core(p, q, ns)
+    p_inf = point_is_infinity(p, ns)
+    q_inf = point_is_infinity(q, ns)
+    out = (x3, y3, z3)
+    out = point_select(q_inf, p, out, ns)
+    out = point_select(p_inf, q, out, ns)
+    return out
+
+
+def point_add_complete(p: Point, q: Point, ns: FieldNS) -> Point:
+    """Jacobian add with the full equal/opposite select ladder (for
+    adversary-controlled inputs, e.g. subgroup-check ladders)."""
+    x3, y3, z3, h, sdiff = _add_core(p, q, ns)
+    p_inf = point_is_infinity(p, ns)
+    q_inf = point_is_infinity(q, ns)
+    eq_x = ns.is_zero_mod(h)
+    eq_y = ns.is_zero_mod(sdiff)
+    dbl = point_double(p, ns)
+    inf = point_infinity(ns, batch_shape=p_inf.shape)
+    out = (x3, y3, z3)
+    out = point_select(eq_x & ~eq_y & ~p_inf & ~q_inf, inf, out, ns)
+    out = point_select(eq_x & eq_y & ~p_inf & ~q_inf, dbl, out, ns)
+    out = point_select(q_inf, p, out, ns)
+    out = point_select(p_inf, q, out, ns)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# scalar multiplication
+# ---------------------------------------------------------------------------
+
+
+def point_mul_bits(p: Point, bits: jnp.ndarray, ns: FieldNS, complete: bool = False) -> Point:
+    """[k]P with per-element dynamic scalars.
+
+    bits: (..., NBITS) uint32 in {0,1}, LSB first, batch axes matching p.
+    Double-and-add with selects; `complete` picks the safe adder.
+    """
+    add = point_add_complete if complete else point_add_unsafe
+    nbits = bits.shape[-1]
+    acc = point_infinity(ns, batch_shape=bits.shape[:-1])
+
+    def body(carry, i):
+        acc, addend = carry
+        bit = jnp.take(bits, i, axis=-1).astype(bool)
+        added = add(acc, addend, ns)
+        acc = point_select(bit, added, acc, ns)
+        addend = point_double(addend, ns)
+        return (acc, addend), None
+
+    (acc, _), _ = lax.scan(body, (acc, p), jnp.arange(nbits))
+    return acc
+
+
+def point_mul_static(p: Point, k: int, ns: FieldNS, complete: bool = True) -> Point:
+    """[k]P for a static python-int scalar (k may be negative).
+
+    MSB-first double-and-add over the constant bit pattern via lax.scan —
+    graph size independent of the scalar length.  Defaults to complete adds:
+    static-scalar ladders are exactly the adversary-facing ones (subgroup
+    checks, cofactor clearing).
+    """
+    if k == 0:
+        return point_infinity(ns, batch_shape=p[2].shape[: p[2].ndim - ns.comp_ndim])
+    if k < 0:
+        return point_mul_static(point_neg(p, ns), -k, ns, complete)
+    add = point_add_complete if complete else point_add_unsafe
+    bits = jnp.asarray(fl._exp_bits(k))  # MSB first
+    acc = point_infinity(ns, batch_shape=p[2].shape[: p[2].ndim - ns.comp_ndim])
+
+    def body(acc, bit):
+        acc = point_double(acc, ns)
+        added = add(acc, p, ns)
+        acc = point_select(bit.astype(bool), added, acc, ns)
+        return acc, None
+
+    acc, _ = lax.scan(body, acc, bits)
+    return acc
+
+
+def point_sum_tree(p: Point, ns: FieldNS, complete: bool = False) -> Point:
+    """Reduce a batch axis (axis 0 of each coordinate's leading dims) by
+    pairwise tree addition — log2(N) levels, each a single vectorized add.
+    Pads odd levels with infinity."""
+    x, y, z = p
+    add = point_add_complete if complete else point_add_unsafe
+    while x.shape[0] > 1:
+        n = x.shape[0]
+        if n % 2:
+            inf = point_infinity(ns, batch_shape=(1,) + x.shape[1 : x.ndim - ns.comp_ndim])
+            x = jnp.concatenate([x, inf[0]])
+            y = jnp.concatenate([y, inf[1]])
+            z = jnp.concatenate([z, inf[2]])
+            n += 1
+        half = n // 2
+        (x, y, z) = add((x[:half], y[:half], z[:half]), (x[half:], y[half:], z[half:]), ns)
+    return (x[0], y[0], z[0])
+
+
+# ---------------------------------------------------------------------------
+# equality / affine / endomorphisms / subgroup checks
+# ---------------------------------------------------------------------------
+
+
+def point_eq(p: Point, q: Point, ns: FieldNS) -> jnp.ndarray:
+    """X1 Z2^2 == X2 Z1^2 and Y1 Z2^3 == Y2 Z1^3, with infinity handling."""
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    s1 = ns.mul_many(ns.stack([z1, z2]), ns.stack([z1, z2]))
+    z1z1, z2z2 = ns.unstack(s1, 2)
+    s2 = ns.mul_many(
+        ns.stack([x1, x2, y1, y2]),
+        ns.stack([z2z2, z1z1, z2z2, z1z1]),
+    )
+    u1, u2, t1, t2 = ns.unstack(s2, 4)
+    s3 = ns.mul_many(ns.stack([t1, t2]), ns.stack([z2, z1]))
+    s1f, s2f = ns.unstack(s3, 2)
+    same = ns.eq_mod(u1, u2) & ns.eq_mod(s1f, s2f)
+    p_inf = point_is_infinity(p, ns)
+    q_inf = point_is_infinity(q, ns)
+    return jnp.where(p_inf | q_inf, p_inf & q_inf, same)
+
+
+def point_to_affine(p: Point, ns: FieldNS):
+    """(X/Z^2, Y/Z^3); caller must ensure not infinity (or mask later)."""
+    zinv = ns.inv(p[2])
+    s = ns.mul_many(ns.stack([zinv]), ns.stack([zinv]))
+    (zinv2,) = ns.unstack(s, 1)
+    s2 = ns.mul_many(ns.stack([p[0], zinv2]), ns.stack([zinv2, zinv]))
+    xa, zinv3 = ns.unstack(s2, 2)
+    s3 = ns.mul_many(ns.stack([p[1]]), ns.stack([zinv3]))
+    (ya,) = ns.unstack(s3, 1)
+    return xa, ya
+
+
+def psi(p: Point) -> Point:
+    """Untwist-Frobenius-twist endomorphism on E2, jacobian-native:
+    psi(X, Y, Z) = (conj(X) * cx, conj(Y) * cy, conj(Z)).
+    Reference analog: curve.py psi() (affine, oracle)."""
+    x, y, z = p
+    cx = jnp.broadcast_to(jnp.asarray(PSI_CX), x.shape)
+    cy = jnp.broadcast_to(jnp.asarray(PSI_CY), y.shape)
+    s = tw.fq2_mul_many(
+        jnp.stack([tw.fq2_conj(x), tw.fq2_conj(y)], axis=-3),
+        jnp.stack([cx, cy], axis=-3),
+    )
+    return (s[..., 0, :, :], s[..., 1, :, :], tw.fq2_conj(z))
+
+
+def g1_sigma(p: Point) -> Point:
+    """sigma(X, Y, Z) = (beta X, Y, Z) — the G1 GLV endomorphism."""
+    x, y, z = p
+    return (fl.fp_mul(x, jnp.asarray(BETA)), y, z)
+
+
+def g1_subgroup_check(p: Point) -> jnp.ndarray:
+    """P in G1 iff sigma(P) == [z^2 - 1]P (complete ladder: adversary picks P).
+    Infinity passes.  Oracle: curve.g1_subgroup_check."""
+    target = point_mul_static(p, F.BLS_X * F.BLS_X - 1, FQ_NS, complete=True)
+    ok = point_eq(g1_sigma(p), target, FQ_NS)
+    return ok | point_is_infinity(p, FQ_NS)
+
+
+def g2_subgroup_check(p: Point) -> jnp.ndarray:
+    """P in G2 iff psi(P) == [z]P (z < 0: computed as [-z](-P)).
+    Oracle: curve.g2_subgroup_check."""
+    target = point_mul_static(p, F.BLS_X, FQ2_NS, complete=True)
+    ok = point_eq(psi(p), target, FQ2_NS)
+    return ok | point_is_infinity(p, FQ2_NS)
+
+
+def g2_clear_cofactor(p: Point) -> Point:
+    """Budroni-Pintore: h_eff P = [z^2-z-1]P + [z-1]psi(P) + psi^2([2]P).
+    Oracle: curve.g2_clear_cofactor.  Complete adds: input is hash output
+    (not attacker-equal), but the final sums can collide for adversarial
+    messages, so stay safe."""
+    z = F.BLS_X
+    t1 = point_mul_static(p, z * z - z - 1, FQ2_NS, complete=True)
+    t2 = point_mul_static(psi(p), z - 1, FQ2_NS, complete=True)
+    t3 = psi(psi(point_double(p, FQ2_NS)))
+    return point_add_complete(point_add_complete(t1, t2, FQ2_NS), t3, FQ2_NS)

@@ -1,0 +1,262 @@
+"""Differential tests: ops.points (jacobian kernels) vs the oracle curve.py."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lodestar_tpu.crypto.bls import curve as C
+from lodestar_tpu.crypto.bls import fields as F
+from lodestar_tpu.ops import limbs as fl
+from lodestar_tpu.ops import points as pt
+from lodestar_tpu.ops import tower as tw
+
+rng = random.Random(0xC0FFEE)
+
+
+def rand_g1(n):
+    return [C.G1_GEN * rng.randrange(1, F.R) for _ in range(n)]
+
+
+def rand_g2(n):
+    return [C.G2_GEN * rng.randrange(1, F.R) for _ in range(n)]
+
+
+def pack_g1(points):
+    """Oracle points -> jacobian limb arrays (affine input, z=1); infinity
+    encoded as exact-zero z."""
+    xs, ys, zs = [], [], []
+    for p in points:
+        if p.is_infinity():
+            xs.append(fl.ONE)
+            ys.append(fl.ONE)
+            zs.append(fl.ZERO)
+        else:
+            ax, ay = p.to_affine()
+            xs.append(fl.int_to_limbs(ax.n))
+            ys.append(fl.int_to_limbs(ay.n))
+            zs.append(fl.ONE)
+    return (jnp.asarray(np.stack(xs)), jnp.asarray(np.stack(ys)), jnp.asarray(np.stack(zs)))
+
+
+def pack_g2(points):
+    xs, ys, zs = [], [], []
+    for p in points:
+        if p.is_infinity():
+            xs.append(tw.FQ2_ONE)
+            ys.append(tw.FQ2_ONE)
+            zs.append(tw.FQ2_ZERO)
+        else:
+            ax, ay = p.to_affine()
+            xs.append(tw.fq2_const(ax))
+            ys.append(tw.fq2_const(ay))
+            zs.append(tw.FQ2_ONE)
+    return (jnp.asarray(np.stack(xs)), jnp.asarray(np.stack(ys)), jnp.asarray(np.stack(zs)))
+
+
+def unpack_g1(p):
+    """Jacobian limb point -> oracle point (batch)."""
+    x, y, z = (np.asarray(a) for a in p)
+    out = []
+    for i in range(x.shape[0]):
+        zi = fl.limbs_to_int(z[i]) % F.P
+        if zi == 0:
+            out.append(C.Point.infinity(C.B1))
+        else:
+            out.append(
+                C.Point(
+                    F.Fq(fl.limbs_to_int(x[i])),
+                    F.Fq(fl.limbs_to_int(y[i])),
+                    F.Fq(zi),
+                    C.B1,
+                )
+            )
+    return out
+
+
+def unpack_g2(p):
+    x, y, z = (np.asarray(a) for a in p)
+    out = []
+    for i in range(x.shape[0]):
+        zf = tw.fq2_to_oracle(z[i])
+        if zf.is_zero():
+            out.append(C.Point.infinity(C.B2))
+        else:
+            out.append(C.Point(tw.fq2_to_oracle(x[i]), tw.fq2_to_oracle(y[i]), zf, C.B2))
+    return out
+
+
+j_dbl_g1 = jax.jit(lambda p: pt.point_double(p, pt.FQ_NS))
+j_dbl_g2 = jax.jit(lambda p: pt.point_double(p, pt.FQ2_NS))
+j_add_g1 = jax.jit(lambda p, q: pt.point_add_unsafe(p, q, pt.FQ_NS))
+j_add_g2 = jax.jit(lambda p, q: pt.point_add_unsafe(p, q, pt.FQ2_NS))
+j_addc_g1 = jax.jit(lambda p, q: pt.point_add_complete(p, q, pt.FQ_NS))
+j_addc_g2 = jax.jit(lambda p, q: pt.point_add_complete(p, q, pt.FQ2_NS))
+j_eq_g1 = jax.jit(lambda p, q: pt.point_eq(p, q, pt.FQ_NS))
+j_mulbits_g1 = jax.jit(lambda p, b: pt.point_mul_bits(p, b, pt.FQ_NS))
+j_mulbits_g2 = jax.jit(lambda p, b: pt.point_mul_bits(p, b, pt.FQ2_NS))
+j_psi = jax.jit(pt.psi)
+j_g1_check = jax.jit(pt.g1_subgroup_check)
+j_g2_check = jax.jit(pt.g2_subgroup_check)
+j_sum_g1 = jax.jit(lambda p: pt.point_sum_tree(p, pt.FQ_NS))
+j_affine_g1 = jax.jit(lambda p: pt.point_to_affine(p, pt.FQ_NS))
+
+
+N = 8
+
+
+class TestDoubleAdd:
+    def test_double_g1(self):
+        ps = rand_g1(N) + [C.Point.infinity(C.B1)]
+        out = unpack_g1(j_dbl_g1(pack_g1(ps)))
+        assert out == [p.double() for p in ps]
+
+    def test_double_g2(self):
+        ps = rand_g2(4) + [C.Point.infinity(C.B2)]
+        out = unpack_g2(j_dbl_g2(pack_g2(ps)))
+        assert out == [p.double() for p in ps]
+
+    def test_add_unsafe_g1(self):
+        ps, qs = rand_g1(N), rand_g1(N)
+        # include infinity on both sides
+        ps.append(C.Point.infinity(C.B1))
+        qs.append(rand_g1(1)[0])
+        ps.append(rand_g1(1)[0])
+        qs.append(C.Point.infinity(C.B1))
+        out = unpack_g1(j_add_g1(pack_g1(ps), pack_g1(qs)))
+        assert out == [p + q for p, q in zip(ps, qs)]
+
+    def test_add_unsafe_g2(self):
+        ps, qs = rand_g2(4), rand_g2(4)
+        out = unpack_g2(j_add_g2(pack_g2(ps), pack_g2(qs)))
+        assert out == [p + q for p, q in zip(ps, qs)]
+
+    def test_add_complete_edge_cases(self):
+        a, b = rand_g1(2)
+        inf = C.Point.infinity(C.B1)
+        ps = [a, a, a, inf, a, inf]
+        qs = [a, -a, b, a, inf, inf]
+        out = unpack_g1(j_addc_g1(pack_g1(ps), pack_g1(qs)))
+        assert out == [p + q for p, q in zip(ps, qs)]
+
+    def test_add_complete_g2_edges(self):
+        a, b = rand_g2(2)
+        ps = [a, a, a]
+        qs = [a, -a, b]
+        out = unpack_g2(j_addc_g2(pack_g2(ps), pack_g2(qs)))
+        assert out == [p + q for p, q in zip(ps, qs)]
+
+
+class TestEqAffine:
+    def test_eq(self):
+        a, b = rand_g1(2)
+        scaled = C.Point(a.x * F.Fq(4), a.y * F.Fq(8), a.z * F.Fq(2), C.B1)  # same affine
+        inf = C.Point.infinity(C.B1)
+        ps = [a, a, inf, a]
+        qs = [scaled, b, inf, inf]
+        out = np.asarray(j_eq_g1(pack_g1(ps), pack_g1(qs)))
+        assert list(out) == [True, False, True, False]
+
+    def test_to_affine(self):
+        ps = rand_g1(4)
+        doubled = j_dbl_g1(pack_g1(ps))  # nontrivial z
+        xa, ya = j_affine_g1(doubled)
+        for i, p in enumerate(ps):
+            ax, ay = p.double().to_affine()
+            assert fl.limbs_to_int(np.asarray(fl.fp_reduce_full(xa))[i]) == ax.n
+            assert fl.limbs_to_int(np.asarray(fl.fp_reduce_full(ya))[i]) == ay.n
+
+
+class TestScalarMul:
+    def test_mul_bits_g1(self):
+        ps = rand_g1(N)
+        ks = [rng.randrange(0, 1 << 64) for _ in range(N)]
+        bits = np.array([[(k >> i) & 1 for i in range(64)] for k in ks], dtype=np.uint32)
+        out = unpack_g1(j_mulbits_g1(pack_g1(ps), jnp.asarray(bits)))
+        assert out == [p * k for p, k in zip(ps, ks)]
+
+    def test_mul_bits_g2(self):
+        ps = rand_g2(4)
+        ks = [rng.randrange(0, 1 << 64) for _ in range(4)]
+        bits = np.array([[(k >> i) & 1 for i in range(64)] for k in ks], dtype=np.uint32)
+        out = unpack_g2(j_mulbits_g2(pack_g2(ps), jnp.asarray(bits)))
+        assert out == [p * k for p, k in zip(ps, ks)]
+
+    def test_mul_static(self):
+        ps = rand_g1(4)
+        for k in (0, 1, 2, 3, F.BLS_X * F.BLS_X - 1):
+            f = jax.jit(lambda p, k=k: pt.point_mul_static(p, k, pt.FQ_NS))
+            out = unpack_g1(f(pack_g1(ps)))
+            assert out == [p * k for p in ps]
+
+    def test_sum_tree(self):
+        for n in (1, 2, 3, 7, 8):
+            ps = rand_g1(n)
+            out = unpack_g1(tuple(a[None] for a in j_sum_g1(pack_g1(ps))))
+            acc = C.Point.infinity(C.B1)
+            for p in ps:
+                acc = acc + p
+            assert out[0] == acc
+
+
+class TestEndomorphisms:
+    def test_psi(self):
+        ps = rand_g2(4)
+        out = unpack_g2(j_psi(pack_g2(ps)))
+        assert out == [C.psi(p) for p in ps]
+
+    def test_g1_subgroup_check(self):
+        good = rand_g1(3)
+        # a point on the curve but not in the subgroup: multiply a random
+        # curve point by r and check it is NOT the identity scaling... build
+        # by scaling x until y^2 = x^3+4 has a root and point is out of G1
+        bad = []
+        x = 5
+        while len(bad) < 2:
+            y2 = F.Fq(x).pow(3) + C.B1
+            y = y2.sqrt()
+            if y is not None:
+                cand = C.Point.from_affine(F.Fq(x), y, C.B1)
+                if not C.g1_subgroup_check(cand):
+                    bad.append(cand)
+            x += 1
+        ps = good + bad + [C.Point.infinity(C.B1)]
+        out = np.asarray(j_g1_check(pack_g1(ps)))
+        assert list(out) == [True, True, True, False, False, True]
+
+    def test_g2_subgroup_check(self):
+        good = rand_g2(2)
+        bad = []
+        x = 1
+        while len(bad) < 1:
+            xf = F.Fq2(x, 1)
+            y2 = xf.square() * xf + C.B2
+            y = y2.sqrt()
+            if y is not None:
+                cand = C.Point.from_affine(xf, y, C.B2)
+                if not C.g2_subgroup_check(cand):
+                    bad.append(cand)
+            x += 1
+        ps = good + bad
+        out = np.asarray(j_g2_check(pack_g2(ps)))
+        assert list(out) == [True, True, False]
+
+    def test_g2_clear_cofactor(self):
+        # random curve (not subgroup) points must land in G2
+        pts = []
+        x = 10
+        while len(pts) < 2:
+            xf = F.Fq2(x, 3)
+            y2 = xf.square() * xf + C.B2
+            y = y2.sqrt()
+            if y is not None:
+                pts.append(C.Point.from_affine(xf, y, C.B2))
+            x += 1
+        f = jax.jit(pt.g2_clear_cofactor)
+        out = unpack_g2(f(pack_g2(pts)))
+        for got, src in zip(out, pts):
+            assert got == C.g2_clear_cofactor(src)
+            assert C.g2_subgroup_check(got)
