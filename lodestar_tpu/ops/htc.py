@@ -1,0 +1,227 @@
+"""Device-side hash-to-G2: branchless SSWU + 3-isogeny + cofactor clearing.
+
+Split of responsibilities (the TPU-first redesign of the reference's
+hash-to-curve, which lives inside blst behind @chainsafe/bls — SURVEY §2.9):
+
+- HOST: expand_message_xmd (sha256) and hash_to_field — byte hashing is what
+  CPUs are good at and is a negligible fraction of the work.  Reuses the
+  oracle implementation (crypto/bls/hash_to_curve.py, RFC 9380 §5).
+- DEVICE (this module): everything after the field draws — the SSWU map on
+  the isogenous curve E', the 3-isogeny to E2, and Budroni-Pintore cofactor
+  clearing — all field/point arithmetic, vmappable over the message batch.
+
+The oracle's branchy SSWU (map_to_curve_sswu) is re-expressed with selects:
+both the tv1==0 exceptional arm and the gx1-nonsquare arm are computed and
+chosen per lane.  sqrt/is_square use static-exponent scans.
+
+Differential-tested against oracle hash_to_g2 in tests/test_ops_htc.py.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..crypto.bls import hash_to_curve as H
+from ..crypto.bls.fields import P as P_INT
+from . import limbs as fl
+from . import tower as tw
+from .limbs import fp_add, fp_strict, fp_sub
+from .points import FQ2_NS, Point, point_add_complete, g2_clear_cofactor
+
+# ---------------------------------------------------------------------------
+# constants from the oracle (computed/standardized there, converted to limbs)
+# ---------------------------------------------------------------------------
+
+ISO_A = tw.fq2_const(H.ISO_A)
+ISO_B = tw.fq2_const(H.ISO_B)
+SSWU_Z = tw.fq2_const(H.SSWU_Z)
+NEG_B_OVER_A = tw.fq2_const(-H.ISO_B * H.ISO_A.inv())
+B_OVER_ZA = tw.fq2_const(H.ISO_B * (H.SSWU_Z * H.ISO_A).inv())
+MINUS_ONE_FQ2 = tw.fq2_const(H.Fq2(P_INT - 1, 0))
+
+K1 = np.stack([tw.fq2_const(c) for c in H._K1])  # x_num, degree 3
+K2 = np.stack([tw.fq2_const(c) for c in H._K2])  # x_den, degree 2 monic
+K3 = np.stack([tw.fq2_const(c) for c in H._K3])  # y_num, degree 3
+K4 = np.stack([tw.fq2_const(c) for c in H._K4])  # y_den, degree 3 monic
+
+
+# ---------------------------------------------------------------------------
+# host: messages -> field element limb arrays
+# ---------------------------------------------------------------------------
+
+
+def hash_to_field_limbs(msgs: List[bytes], dst: bytes = H.DST_G2) -> np.ndarray:
+    """Host stage: sha256 expand + reduce (oracle hash_to_field_fq2), packed
+    as (N, 2, 2, 26) — two Fq2 draws per message."""
+    out = np.zeros((len(msgs), 2, 2, fl.NLIMBS), dtype=np.uint32)
+    for i, m in enumerate(msgs):
+        u0, u1 = H.hash_to_field_fq2(m, 2, dst)
+        out[i, 0] = tw.fq2_const(u0)
+        out[i, 1] = tw.fq2_const(u1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# device: Fq2 sqrt / is_square (static-exponent scans)
+# ---------------------------------------------------------------------------
+
+
+def fq2_is_square(a: jnp.ndarray) -> jnp.ndarray:
+    """Legendre via the norm: a square in Fq2 iff (c0^2+c1^2)^((p-1)/2) != -1."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    sq = fl.fp_mul(jnp.stack([a0, a1], axis=-2), jnp.stack([a0, a1], axis=-2))
+    norm = fp_strict(fp_add(sq[..., 0, :], sq[..., 1, :]))
+    chi = fl.fp_pow_static(norm, (P_INT - 1) // 2)
+    return ~jnp.all(fl.fp_reduce_full(chi) == fl.int_to_limbs(P_INT - 1), axis=-1)
+
+
+def _fq2_pow_static(a: jnp.ndarray, e: int) -> jnp.ndarray:
+    """a^e in Fq2 for a static exponent, via scan (like fp_pow_static)."""
+    from jax import lax
+
+    bits = jnp.asarray(fl._exp_bits(e))
+
+    def body(r, bit):
+        r = tw.fq2_sqr(r)
+        r = jnp.where(bit.astype(bool)[..., None, None], tw.fq2_mul(r, a), r)
+        return r, None
+
+    init = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE), a.shape).astype(jnp.uint32)
+    out, _ = lax.scan(body, init, bits)
+    return out
+
+
+def fq2_sqrt(a: jnp.ndarray) -> jnp.ndarray:
+    """Square root for p % 4 == 3 (oracle Fq2.sqrt, branchless).
+
+    Returns a value whose square is a when a is a QR (callers guarantee it).
+    """
+    a1 = _fq2_pow_static(a, (P_INT - 3) // 4)
+    m = tw.fq2_mul_many(jnp.stack([a1, a1], axis=-3), jnp.stack([a1, a], axis=-3))
+    a1sq, x0 = m[..., 0, :, :], m[..., 1, :, :]
+    alpha = tw.fq2_mul(a1sq, a)
+    is_neg1 = tw.fq2_eq(alpha, jnp.broadcast_to(jnp.asarray(MINUS_ONE_FQ2), alpha.shape))
+    # branch A: i * x0 = (-x0.c1, x0.c0)
+    cand_a = jnp.stack([fl.fp_neg(x0[..., 1, :]), x0[..., 0, :]], axis=-2)
+    # branch B: (alpha + 1)^((p-1)/2) * x0
+    one = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE), alpha.shape).astype(jnp.uint32)
+    b = _fq2_pow_static(fp_strict(fp_add(alpha, one)), (P_INT - 1) // 2)
+    cand_b = tw.fq2_mul(b, x0)
+    return jnp.where(is_neg1[..., None, None], cand_a, cand_b)
+
+
+def fq2_sgn0(a: jnp.ndarray) -> jnp.ndarray:
+    """RFC 9380 sgn0 for m=2 (oracle Fq2.sgn0): parity of c0, or of c1 when
+    c0 == 0.  Needs the canonical residue, hence a full reduction."""
+    r0 = fl.fp_reduce_full(a[..., 0, :])
+    r1 = fl.fp_reduce_full(a[..., 1, :])
+    sign0 = (r0[..., 0] & 1).astype(bool)
+    zero0 = jnp.all(r0 == 0, axis=-1)
+    sign1 = (r1[..., 0] & 1).astype(bool)
+    return sign0 | (zero0 & sign1)
+
+
+# ---------------------------------------------------------------------------
+# device: SSWU + isogeny
+# ---------------------------------------------------------------------------
+
+
+def _gprime(x: jnp.ndarray) -> jnp.ndarray:
+    """g'(x) = x^3 + A'x + B' on E' (oracle _gprime)."""
+    x2 = tw.fq2_sqr(x)
+    m = tw.fq2_mul_many(
+        jnp.stack([x2, x], axis=-3),
+        jnp.stack([x, jnp.broadcast_to(jnp.asarray(ISO_A), x.shape).astype(jnp.uint32)], axis=-3),
+    )
+    x3, ax = m[..., 0, :, :], m[..., 1, :, :]
+    return fp_strict(fp_add(fp_add(x3, ax), jnp.broadcast_to(jnp.asarray(ISO_B), x.shape)))
+
+
+def map_to_curve_sswu(u: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Simplified SWU onto E' (oracle map_to_curve_sswu, select-based)."""
+    z = jnp.broadcast_to(jnp.asarray(SSWU_Z), u.shape).astype(jnp.uint32)
+    u2 = tw.fq2_sqr(u)
+    m1 = tw.fq2_mul_many(jnp.stack([u2, u2], axis=-3), jnp.stack([u2, z], axis=-3))
+    u4, zu2 = m1[..., 0, :, :], m1[..., 1, :, :]
+    m2 = tw.fq2_mul_many(
+        jnp.stack([u4], axis=-3),
+        jnp.stack([tw.fq2_sqr(z)], axis=-3),
+    )
+    z2u4 = m2[..., 0, :, :]
+    tv1 = fp_strict(fp_add(z2u4, zu2))
+    tv1_zero = tw.fq2_is_zero(tv1)
+    # regular arm: x1 = (-B/A) * (1 + 1/tv1)
+    tv1_inv = tw.fq2_inv(tv1)
+    one = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE), u.shape).astype(jnp.uint32)
+    nba = jnp.broadcast_to(jnp.asarray(NEG_B_OVER_A), u.shape).astype(jnp.uint32)
+    x1_reg = tw.fq2_mul(nba, fp_strict(fp_add(one, tv1_inv)))
+    # exceptional arm: x1 = B / (Z*A)
+    x1_exc = jnp.broadcast_to(jnp.asarray(B_OVER_ZA), u.shape).astype(jnp.uint32)
+    x1 = jnp.where(tv1_zero[..., None, None], x1_exc, x1_reg)
+    gx1 = _gprime(x1)
+    square1 = fq2_is_square(gx1)
+    x2 = tw.fq2_mul(zu2, x1)
+    gx2 = _gprime(x2)
+    x = jnp.where(square1[..., None, None], x1, x2)
+    gx = jnp.where(square1[..., None, None], gx1, gx2)
+    y = fq2_sqrt(gx)
+    # sign correction: sgn0(y) must equal sgn0(u)
+    flip = fq2_sgn0(u) != fq2_sgn0(y)
+    y = jnp.where(flip[..., None, None], jnp.stack([fl.fp_neg(y[..., 0, :]), fl.fp_neg(y[..., 1, :])], axis=-2), y)
+    return x, y
+
+
+def _eval_poly(coeffs: np.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """Horner with constant Fq2 coefficients (oracle _eval_poly)."""
+    acc = jnp.broadcast_to(jnp.asarray(coeffs[-1]), x.shape).astype(jnp.uint32)
+    for c in reversed(coeffs[:-1]):
+        acc = fp_strict(fp_add(tw.fq2_mul(acc, x), jnp.broadcast_to(jnp.asarray(c), x.shape)))
+    return acc
+
+
+def iso_map(x: jnp.ndarray, y: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """3-isogeny E' -> E2 (oracle _iso_map), with a single shared inversion:
+    1/(x_den * y_den)."""
+    x_num = _eval_poly(K1, x)
+    x_den = _eval_poly(K2, x)
+    y_num = _eval_poly(K3, x)
+    y_den = _eval_poly(K4, x)
+    m = tw.fq2_mul_many(jnp.stack([x_den], axis=-3), jnp.stack([y_den], axis=-3))
+    dinv = tw.fq2_inv(m[..., 0, :, :])
+    m2 = tw.fq2_mul_many(jnp.stack([x_num, y_num], axis=-3), jnp.stack([y_den, x_den], axis=-3))
+    xn_yd, yn_xd = m2[..., 0, :, :], m2[..., 1, :, :]
+    m3 = tw.fq2_mul_many(jnp.stack([xn_yd, yn_xd], axis=-3), jnp.stack([dinv, dinv], axis=-3))
+    xm = m3[..., 0, :, :]
+    m4 = tw.fq2_mul_many(jnp.stack([y], axis=-3), jnp.stack([m3[..., 1, :, :]], axis=-3))
+    ym = m4[..., 0, :, :]
+    return xm, ym
+
+
+def map_to_curve_g2(u: jnp.ndarray) -> Point:
+    """SSWU + isogeny -> jacobian point on E2 (z = 1)."""
+    x, y = map_to_curve_sswu(u)
+    xm, ym = iso_map(x, y)
+    z = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE), xm.shape).astype(jnp.uint32)
+    return (xm, ym, z)
+
+
+def hash_to_g2_device(u: jnp.ndarray) -> Point:
+    """Device stage of hash_to_g2 (oracle hash_to_g2 after hash_to_field).
+
+    u: (..., 2, 2, 26) — the two Fq2 draws per message (from
+    hash_to_field_limbs).  Maps both draws through SSWU+isogeny in one
+    stacked call, adds them (complete add: adversarial messages could
+    collide the two maps), clears the cofactor.
+    """
+    u0 = u[..., 0, :, :]
+    u1 = u[..., 1, :, :]
+    both = jnp.stack([u0, u1], axis=0)  # (2, ..., 2, 26) — one map for both draws
+    q = map_to_curve_g2(both)
+    q0 = (q[0][0], q[1][0], q[2][0])
+    q1 = (q[0][1], q[1][1], q[2][1])
+    summed = point_add_complete(q0, q1, FQ2_NS)
+    return g2_clear_cofactor(summed)

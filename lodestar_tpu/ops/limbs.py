@@ -131,22 +131,30 @@ for _i in range(NLIMBS):
 # ---------------------------------------------------------------------------
 
 
+_CARRY_UNROLL = 4
+
+
 def _carry_u(x: jnp.ndarray) -> jnp.ndarray:
     """Exact unsigned carry propagation.
 
     x: (..., W) uint32 digits, each < 2^31.  Returns (..., W+1) strict
     digits (< 2^16) of the same value.  The appended final carry is < 2^16
     (fixed point of c' = (2^31 + c) >> 16 is ~2^15).
+
+    Implemented as a lax.scan along the digit axis: carries are inherently
+    sequential, and the scan keeps the XLA graph O(1) in the width (compile
+    time matters: every field op runs this).
     """
-    w = x.shape[-1]
-    digits = []
-    carry = jnp.zeros(x.shape[:-1], dtype=jnp.uint32)
-    for i in range(w):
-        t = x[..., i] + carry
-        digits.append(t & MASK)
-        carry = t >> LIMB_BITS
-    digits.append(carry)
-    return jnp.stack(digits, axis=-1)
+    xt = jnp.moveaxis(x, -1, 0)  # (W, ...)
+
+    def body(carry, digit):
+        t = digit + carry
+        return t >> LIMB_BITS, t & MASK
+
+    carry, digits = lax.scan(
+        body, jnp.zeros(x.shape[:-1], dtype=jnp.uint32), xt, unroll=_CARRY_UNROLL
+    )
+    return jnp.concatenate([jnp.moveaxis(digits, 0, -1), carry[..., None]], axis=-1)
 
 
 def _carry_s(x: jnp.ndarray) -> jnp.ndarray:
@@ -157,15 +165,18 @@ def _carry_s(x: jnp.ndarray) -> jnp.ndarray:
     right shift floors toward -inf, so intermediate borrows are handled
     branchlessly; the final carry is non-negative because the value is.
     """
-    w = x.shape[-1]
-    digits = []
-    carry = jnp.zeros(x.shape[:-1], dtype=jnp.int32)
-    for i in range(w):
-        t = x[..., i] + carry
-        digits.append((t & MASK).astype(jnp.uint32))
-        carry = t >> LIMB_BITS
-    digits.append(carry.astype(jnp.uint32))
-    return jnp.stack(digits, axis=-1)
+    xt = jnp.moveaxis(x, -1, 0)
+
+    def body(carry, digit):
+        t = digit + carry
+        return t >> LIMB_BITS, (t & MASK).astype(jnp.uint32)
+
+    carry, digits = lax.scan(
+        body, jnp.zeros(x.shape[:-1], dtype=jnp.int32), xt, unroll=_CARRY_UNROLL
+    )
+    return jnp.concatenate(
+        [jnp.moveaxis(digits, 0, -1), carry.astype(jnp.uint32)[..., None]], axis=-1
+    )
 
 
 def _finalize(x: jnp.ndarray) -> jnp.ndarray:
@@ -285,14 +296,15 @@ def fp_select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 def _cond_sub(a: jnp.ndarray, c: np.ndarray) -> jnp.ndarray:
     """a - c if a >= c else a, both strict 26-digit, c a numpy constant."""
     d = a.astype(jnp.int32) - jnp.asarray(np.pad(c, (0, NLIMBS - len(c))).astype(np.int32))
-    w = d.shape[-1]
-    digits = []
-    carry = jnp.zeros(d.shape[:-1], dtype=jnp.int32)
-    for i in range(w):
-        t = d[..., i] + carry
-        digits.append((t & MASK).astype(jnp.uint32))
-        carry = t >> LIMB_BITS
-    sub = jnp.stack(digits, axis=-1)
+
+    def body(carry, digit):
+        t = digit + carry
+        return t >> LIMB_BITS, (t & MASK).astype(jnp.uint32)
+
+    carry, digits = lax.scan(
+        body, jnp.zeros(d.shape[:-1], dtype=jnp.int32), jnp.moveaxis(d, -1, 0), unroll=_CARRY_UNROLL
+    )
+    sub = jnp.moveaxis(digits, 0, -1)
     return jnp.where((carry >= 0)[..., None], sub, a)
 
 

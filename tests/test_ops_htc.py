@@ -1,0 +1,94 @@
+"""Differential tests: ops.htc (device hash-to-G2 stages) vs the oracle."""
+
+import random
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from lodestar_tpu.crypto.bls import curve as C
+from lodestar_tpu.crypto.bls import fields as F
+from lodestar_tpu.crypto.bls import hash_to_curve as H
+from lodestar_tpu.ops import htc
+from lodestar_tpu.ops import limbs as fl
+from lodestar_tpu.ops import tower as tw
+
+rng = random.Random(0x2380)
+
+
+def rand_fq2(n):
+    return [F.Fq2(rng.randrange(F.P), rng.randrange(F.P)) for _ in range(n)]
+
+
+def pack_fq2(vals):
+    return jnp.asarray(np.stack([tw.fq2_const(v) for v in vals]))
+
+
+j_is_square = jax.jit(htc.fq2_is_square)
+j_sqrt = jax.jit(htc.fq2_sqrt)
+j_sgn0 = jax.jit(htc.fq2_sgn0)
+j_sswu = jax.jit(htc.map_to_curve_sswu)
+j_map = jax.jit(htc.map_to_curve_g2)
+j_hash = jax.jit(htc.hash_to_g2_device)
+
+
+def unpack_g2_jac(p):
+    x, y, z = (np.asarray(a) for a in p)
+    out = []
+    for i in range(x.shape[0]):
+        zf = tw.fq2_to_oracle(z[i])
+        if zf.is_zero():
+            out.append(C.Point.infinity(C.B2))
+        else:
+            out.append(C.Point(tw.fq2_to_oracle(x[i]), tw.fq2_to_oracle(y[i]), zf, C.B2))
+    return out
+
+
+class TestFq2SqrtSign:
+    def test_is_square(self):
+        vals = rand_fq2(6)
+        vals += [v.square() for v in vals[:3]]
+        vals += [F.Fq2.zero(), F.Fq2.one()]
+        out = np.asarray(j_is_square(pack_fq2(vals)))
+        assert list(out) == [v.is_square() for v in vals]
+
+    def test_sqrt_of_squares(self):
+        vals = [v.square() for v in rand_fq2(6)]
+        out = np.asarray(j_sqrt(pack_fq2(vals)))
+        for row, v in zip(out, vals):
+            got = tw.fq2_to_oracle(row)
+            assert got.square() == v
+
+    def test_sgn0(self):
+        vals = rand_fq2(6) + [F.Fq2.zero(), F.Fq2(0, 1), F.Fq2(0, 2), F.Fq2(1, 0), F.Fq2(2, 0)]
+        out = np.asarray(j_sgn0(pack_fq2(vals)))
+        assert [bool(b) for b in out] == [bool(v.sgn0()) for v in vals]
+
+
+class TestSSWU:
+    def test_map_vs_oracle(self):
+        us = rand_fq2(4)
+        x, y = j_sswu(pack_fq2(us))
+        for i, u in enumerate(us):
+            ox, oy = H.map_to_curve_sswu(u)
+            got_x = tw.fq2_to_oracle(np.asarray(x)[i])
+            got_y = tw.fq2_to_oracle(np.asarray(y)[i])
+            assert (got_x, got_y) == (ox, oy)
+
+    def test_iso_map_point(self):
+        us = rand_fq2(4)
+        pts = unpack_g2_jac(j_map(pack_fq2(us)))
+        for got, u in zip(pts, us):
+            assert got == H.map_to_curve_g2(u)
+
+
+class TestHashToG2:
+    def test_full_vs_oracle(self):
+        msgs = [b"", b"abc", b"a longer message for hash to curve", bytes(range(64))]
+        u = jnp.asarray(htc.hash_to_field_limbs(msgs))
+        pts = unpack_g2_jac(j_hash(u))
+        for got, m in zip(pts, msgs):
+            want = H.hash_to_g2(m)
+            assert got == want
+            assert C.g2_subgroup_check(got)

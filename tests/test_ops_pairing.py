@@ -1,0 +1,94 @@
+"""Differential tests: ops.pairing vs the oracle pairing.
+
+Note the kernel's raw Miller value differs from the oracle's by Fq2
+subfield factors (inversion-free lines); equality holds after final
+exponentiation — which is exactly the guarantee the verifier needs.
+"""
+
+import random
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from lodestar_tpu.crypto.bls import curve as C
+from lodestar_tpu.crypto.bls import fields as F
+from lodestar_tpu.crypto.bls import pairing as OP
+from lodestar_tpu.crypto.bls.hash_to_curve import hash_to_g2
+from lodestar_tpu.ops import limbs as fl
+from lodestar_tpu.ops import pairing as kp
+from lodestar_tpu.ops import tower as tw
+
+rng = random.Random(0xA17)
+
+
+def pack_affine_g1(points):
+    xs, ys = [], []
+    for p in points:
+        ax, ay = p.to_affine()
+        xs.append(fl.int_to_limbs(ax.n))
+        ys.append(fl.int_to_limbs(ay.n))
+    return jnp.asarray(np.stack(xs)), jnp.asarray(np.stack(ys))
+
+
+def pack_affine_g2(points):
+    xs, ys = [], []
+    for p in points:
+        ax, ay = p.to_affine()
+        xs.append(tw.fq2_const(ax))
+        ys.append(tw.fq2_const(ay))
+    return jnp.asarray(np.stack(xs)), jnp.asarray(np.stack(ys))
+
+
+j_final_exp = jax.jit(kp.final_exponentiation)
+j_pairing = jax.jit(kp.pairing)
+j_product_check = jax.jit(kp.pairing_product_is_one)
+
+
+class TestFinalExp:
+    def test_vs_oracle(self):
+        vals = [
+            F.Fq12(
+                F.Fq6(*[F.Fq2(rng.randrange(F.P), rng.randrange(F.P)) for _ in range(3)]),
+                F.Fq6(*[F.Fq2(rng.randrange(F.P), rng.randrange(F.P)) for _ in range(3)]),
+            )
+            for _ in range(2)
+        ]
+        packed = np.stack([tw.fq12_const(v) for v in vals])
+        out = np.asarray(j_final_exp(jnp.asarray(packed)))
+        for row, v in zip(out, vals):
+            assert tw.fq12_to_oracle(row) == OP.final_exponentiation(v)
+
+
+class TestPairing:
+    def test_vs_oracle(self):
+        g1s = [C.G1_GEN * rng.randrange(1, F.R) for _ in range(2)]
+        g2s = [C.G2_GEN * rng.randrange(1, F.R) for _ in range(2)]
+        xp, yp = pack_affine_g1(g1s)
+        xq, yq = pack_affine_g2(g2s)
+        out = np.asarray(j_pairing(xp, yp, xq, yq))
+        for row, p, q in zip(out, g1s, g2s):
+            assert tw.fq12_to_oracle(row) == OP.pairing(p, q)
+
+    def test_bls_verify_relation(self):
+        # e(-g1, sig) * e(pk, H(m)) == 1 for a valid signature
+        sk = rng.randrange(1, F.R)
+        pk = C.G1_GEN * sk
+        h = hash_to_g2(b"kernel pairing test message")
+        sig = h * sk
+        # batch of 2 pairs + 2 masked padding entries (use generator coords)
+        g1s = [-C.G1_GEN, pk, C.G1_GEN, C.G1_GEN]
+        g2s = [sig, h, C.G2_GEN, C.G2_GEN]
+        xp, yp = pack_affine_g1(g1s)
+        xq, yq = pack_affine_g2(g2s)
+        mask = jnp.asarray(np.array([True, True, False, False]))
+        assert bool(j_product_check(xp, yp, xq, yq, mask))
+        # corrupt: wrong message
+        h2 = hash_to_g2(b"a different message")
+        g2s_bad = [sig, h2, C.G2_GEN, C.G2_GEN]
+        xq2, yq2 = pack_affine_g2(g2s_bad)
+        assert not bool(j_product_check(xp, yp, xq2, yq2, mask))
+        # mask flips matter: unmasking the padding should break it
+        mask_all = jnp.asarray(np.array([True, True, True, True]))
+        assert not bool(j_product_check(xp, yp, xq, yq, mask_all))

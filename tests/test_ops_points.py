@@ -187,7 +187,7 @@ class TestScalarMul:
 
     def test_mul_static(self):
         ps = rand_g1(4)
-        for k in (0, 1, 2, 3, F.BLS_X * F.BLS_X - 1):
+        for k in (0, 3, F.BLS_X * F.BLS_X - 1):
             f = jax.jit(lambda p, k=k: pt.point_mul_static(p, k, pt.FQ_NS))
             out = unpack_g1(f(pack_g1(ps)))
             assert out == [p * k for p in ps]

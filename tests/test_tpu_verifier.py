@@ -1,0 +1,152 @@
+"""TpuBlsVerifier end-to-end: the same test matrix as PyBlsVerifier
+(tests/test_bls_py.py TestVerifierBoundary) driven through the batched
+device kernel, plus cross-verifier differential checks.
+
+Runs on the CPU backend (conftest pins JAX_PLATFORMS=cpu); the kernel code
+is backend-agnostic.
+"""
+
+import random
+
+import pytest
+
+from lodestar_tpu.crypto.bls.api import (
+    SecretKey,
+    aggregate_signatures,
+    interop_secret_key,
+)
+from lodestar_tpu.crypto.bls.tpu_verifier import TpuBlsVerifier
+from lodestar_tpu.crypto.bls.verifier import (
+    AggregatedSignatureSet,
+    PyBlsVerifier,
+    SingleSignatureSet,
+)
+
+rng = random.Random(0xBEEF)
+MSG = b"\x42" * 32
+
+
+@pytest.fixture(scope="module")
+def verifier():
+    v = TpuBlsVerifier(buckets=(4, 8))
+    yield v
+    v.close()
+
+
+def make_sets(n, start=0):
+    out = []
+    for i in range(start, start + n):
+        sk = interop_secret_key(i)
+        msg = bytes([i % 256]) * 32
+        out.append(
+            SingleSignatureSet(
+                pubkey=sk.to_public_key(),
+                signing_root=msg,
+                signature=sk.sign(msg).to_bytes(),
+            )
+        )
+    return out
+
+
+class TestTpuVerifierMatrix:
+    def test_valid_sets(self, verifier):
+        assert verifier.verify_signature_sets(make_sets(3))
+
+    def test_single_set(self, verifier):
+        assert verifier.verify_signature_sets(make_sets(1))
+
+    def test_invalid_set_detected(self, verifier):
+        sets = make_sets(3)
+        sets[1].signature = interop_secret_key(9).sign(sets[1].signing_root).to_bytes()
+        assert not verifier.verify_signature_sets(sets)
+
+    def test_wrong_message_detected(self, verifier):
+        sets = make_sets(2)
+        sets[0].signing_root = b"\x99" * 32
+        assert not verifier.verify_signature_sets(sets)
+
+    def test_aggregated_set(self, verifier):
+        sks = [interop_secret_key(i) for i in range(4)]
+        agg = aggregate_signatures([s.sign(MSG) for s in sks])
+        s = AggregatedSignatureSet(
+            pubkeys=[s.to_public_key() for s in sks],
+            signing_root=MSG,
+            signature=agg.to_bytes(),
+        )
+        assert verifier.verify_signature_sets([s])
+
+    def test_malformed_signature_bytes_rejected_not_raised(self, verifier):
+        sets = make_sets(3)
+        sets[0].signature = b"\x00" * 96
+        assert not verifier.verify_signature_sets(sets)
+
+    def test_empty_batch_false(self, verifier):
+        assert not verifier.verify_signature_sets([])
+
+    def test_padding_lanes_do_not_leak(self, verifier):
+        # bucket 4 with 2 live sets: padding copies lane 0; a bad lane 0
+        # must fail even though its copies are masked
+        sets = make_sets(2)
+        sets[0].signature = interop_secret_key(7).sign(sets[0].signing_root).to_bytes()
+        assert not verifier.verify_signature_sets(sets)
+
+    def test_oversized_batch_chunks(self, verifier):
+        # > largest bucket (8): exercises the chunkify path
+        sets = make_sets(10)
+        assert verifier.verify_signature_sets(sets)
+        sets[9].signing_root = b"\x01" * 32
+        assert not verifier.verify_signature_sets(sets)
+
+    def test_differential_vs_py_verifier(self, verifier):
+        py = PyBlsVerifier()
+        for trial in range(4):
+            sets = make_sets(3, start=trial * 3)
+            if trial % 2:
+                k = rng.randrange(3)
+                sets[k].signature = interop_secret_key(50 + trial).sign(sets[k].signing_root).to_bytes()
+            assert verifier.verify_signature_sets(sets) == py.verify_signature_sets(sets)
+
+    def test_metrics_counters(self, verifier):
+        before = verifier.dispatches
+        verifier.verify_signature_sets(make_sets(2))
+        assert verifier.dispatches == before + 1
+        assert verifier.sets_verified >= 2
+
+
+class TestAdversarial:
+    def test_non_subgroup_signature_rejected(self, verifier):
+        # forge bytes for an on-curve, non-subgroup G2 point
+        from lodestar_tpu.crypto.bls import curve as C
+        from lodestar_tpu.crypto.bls import fields as F
+
+        x = 1
+        bad = None
+        while bad is None:
+            xf = F.Fq2(x, 1)
+            y2 = xf.square() * xf + C.B2
+            y = y2.sqrt()
+            if y is not None:
+                cand = C.Point.from_affine(xf, y, C.B2)
+                if not C.g2_subgroup_check(cand):
+                    bad = cand
+            x += 1
+        sets = make_sets(2)
+        sets[1].signature = C.g2_to_bytes(bad)
+        assert not verifier.verify_signature_sets(sets)
+
+    def test_infinity_pubkey_rejected(self, verifier):
+        from lodestar_tpu.crypto.bls.api import PublicKey
+        from lodestar_tpu.crypto.bls import curve as C
+
+        sets = make_sets(1)
+        s = AggregatedSignatureSet(
+            pubkeys=[PublicKey(C.Point.infinity(C.B1))],
+            signing_root=sets[0].signing_root,
+            signature=sets[0].signature,
+        )
+        assert not verifier.verify_signature_sets([s])
+
+    def test_duplicate_sets_ok(self, verifier):
+        # identical sets in one batch (RLC coefficients differ per lane)
+        s = make_sets(1)
+        assert verifier.verify_signature_sets([s[0], s[0], s[0]])
