@@ -208,15 +208,32 @@ def point_add_unsafe(p: Point, q: Point, ns: FieldNS) -> Point:
     return out
 
 
+def point_double_complete(p: Point, ns: FieldNS) -> Point:
+    """Double with residue-exact edge handling: doubling a 2-torsion point
+    (y == 0 mod p) or a phantom infinity (z == 0 mod p, digits not exactly
+    zero — produced by cancellations in the redundant representation)
+    canonicalizes to the exact infinity encoding."""
+    out = point_double(p, ns)
+    degenerate = ns.is_zero_mod(p[1]) | ns.is_zero_mod(p[2])
+    inf = point_infinity(ns, batch_shape=degenerate.shape)
+    return point_select(degenerate, inf, out, ns)
+
+
 def point_add_complete(p: Point, q: Point, ns: FieldNS) -> Point:
     """Jacobian add with the full equal/opposite select ladder (for
-    adversary-controlled inputs, e.g. subgroup-check ladders)."""
+    adversary-controlled inputs, e.g. subgroup-check ladders).
+
+    Infinity detection here is RESIDUE-based (z == 0 mod p), not exact-zero:
+    adversarial small-order points can drive intermediate results through
+    2-torsion (y == 0) and produce z-residue zeros with nonzero digits; the
+    exact-zero convention only covers deliberately constructed infinities.
+    """
     x3, y3, z3, h, sdiff = _add_core(p, q, ns)
-    p_inf = point_is_infinity(p, ns)
-    q_inf = point_is_infinity(q, ns)
+    p_inf = ns.is_zero_mod(p[2])
+    q_inf = ns.is_zero_mod(q[2])
     eq_x = ns.is_zero_mod(h)
     eq_y = ns.is_zero_mod(sdiff)
-    dbl = point_double(p, ns)
+    dbl = point_double_complete(p, ns)
     inf = point_infinity(ns, batch_shape=p_inf.shape)
     out = (x3, y3, z3)
     out = point_select(eq_x & ~eq_y & ~p_inf & ~q_inf, inf, out, ns)
@@ -238,6 +255,7 @@ def point_mul_bits(p: Point, bits: jnp.ndarray, ns: FieldNS, complete: bool = Fa
     Double-and-add with selects; `complete` picks the safe adder.
     """
     add = point_add_complete if complete else point_add_unsafe
+    dbl = point_double_complete if complete else point_double
     nbits = bits.shape[-1]
     acc = point_infinity(ns, batch_shape=bits.shape[:-1])
 
@@ -246,7 +264,7 @@ def point_mul_bits(p: Point, bits: jnp.ndarray, ns: FieldNS, complete: bool = Fa
         bit = jnp.take(bits, i, axis=-1).astype(bool)
         added = add(acc, addend, ns)
         acc = point_select(bit, added, acc, ns)
-        addend = point_double(addend, ns)
+        addend = dbl(addend, ns)
         return (acc, addend), None
 
     (acc, _), _ = lax.scan(body, (acc, p), jnp.arange(nbits))
@@ -266,11 +284,12 @@ def point_mul_static(p: Point, k: int, ns: FieldNS, complete: bool = True) -> Po
     if k < 0:
         return point_mul_static(point_neg(p, ns), -k, ns, complete)
     add = point_add_complete if complete else point_add_unsafe
+    dbl = point_double_complete if complete else point_double
     bits = jnp.asarray(fl._exp_bits(k))  # MSB first
     acc = point_infinity(ns, batch_shape=p[2].shape[: p[2].ndim - ns.comp_ndim])
 
     def body(acc, bit):
-        acc = point_double(acc, ns)
+        acc = dbl(acc, ns)
         added = add(acc, p, ns)
         acc = point_select(bit.astype(bool), added, acc, ns)
         return acc, None

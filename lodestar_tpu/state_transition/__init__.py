@@ -1,0 +1,13 @@
+"""Beacon chain state transition (phase0-first) + caches + signature sets.
+
+Reference: packages/state-transition (src/stateTransition.ts:19 entry,
+src/cache/epochContext.ts:78 caches, src/signatureSets/index.ts:23
+collectors).  See SURVEY.md §2.2.
+"""
+
+from .domain import (  # noqa: F401
+    compute_domain,
+    compute_fork_data_root,
+    compute_fork_digest,
+    compute_signing_root,
+)
