@@ -400,10 +400,22 @@ class Fields:
         object.__setattr__(self, "_d", dict(kwargs))
 
     def __getattr__(self, k):
+        # robust under copy/pickle: _d may not exist yet, and dunder probes
+        # (__deepcopy__, __getstate__, ...) must fail cleanly
         try:
-            return self._d[k]
+            d = object.__getattribute__(self, "_d")
+        except AttributeError:
+            raise AttributeError(k) from None
+        try:
+            return d[k]
         except KeyError:
             raise AttributeError(k) from None
+
+    def __getstate__(self):
+        return object.__getattribute__(self, "_d")
+
+    def __setstate__(self, state):
+        object.__setattr__(self, "_d", state)
 
     def __setattr__(self, k, v):
         self._d[k] = v

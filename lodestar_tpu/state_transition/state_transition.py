@@ -1,0 +1,111 @@
+"""The state transition function: process_slots + per-block transition.
+
+Reference: packages/state-transition/src/stateTransition.ts:19
+(eth2fastspec-style: verify-signatures flags so block signature checks can
+be deferred to the batched device verifier) and :79 processSlots.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+from ..config.chain_config import ChainConfig
+from ..params import Preset
+from ..types import get_types
+from .block import BlockProcessingError, process_block
+from .epoch import process_epoch
+from .epoch_context import EpochContext
+from .misc import compute_epoch_at_slot
+
+
+class StateTransitionError(Exception):
+    pass
+
+
+def clone_state(p: Preset, state):
+    """Deep-copy a state value.  SSZ values are plain python data, so
+    copy.deepcopy is correct; columnar caches (EpochContext) are rebuilt,
+    not copied — they derive from the state."""
+    return copy.deepcopy(state)
+
+
+def process_slot(p: Preset, state) -> None:
+    """Cache state root + block root for the slot (spec process_slot)."""
+    t = get_types(p).phase0
+    prev_state_root = t.BeaconState.hash_tree_root(state)
+    state.state_roots[state.slot % p.SLOTS_PER_HISTORICAL_ROOT] = prev_state_root
+    if state.latest_block_header.state_root == b"\x00" * 32:
+        state.latest_block_header.state_root = prev_state_root
+    block_root = t.BeaconBlockHeader.hash_tree_root(state.latest_block_header)
+    state.block_roots[state.slot % p.SLOTS_PER_HISTORICAL_ROOT] = block_root
+
+
+def process_slots(
+    p: Preset,
+    cfg: ChainConfig,
+    state,
+    slot: int,
+    ctx: Optional[EpochContext] = None,
+) -> EpochContext:
+    """Advance state (in place) to `slot`, running epoch transitions at
+    boundaries.  Returns a fresh EpochContext for the final epoch."""
+    if state.slot > slot:
+        raise StateTransitionError(f"cannot rewind state from {state.slot} to {slot}")
+    if ctx is None:
+        ctx = EpochContext.create_from_state(p, state)
+    while state.slot < slot:
+        process_slot(p, state)
+        if (state.slot + 1) % p.SLOTS_PER_EPOCH == 0:
+            process_epoch(p, cfg, ctx, state)
+            state.slot += 1
+            ctx = EpochContext.create_from_state(
+                p, state, ctx.pubkey2index, ctx.index2pubkey
+            )
+        else:
+            state.slot += 1
+    return ctx
+
+
+def state_transition(
+    p: Preset,
+    cfg: ChainConfig,
+    state,
+    signed_block,
+    ctx: Optional[EpochContext] = None,
+    verify_proposer_signature: bool = True,
+    verify_signatures: bool = True,
+    verify_state_root: bool = True,
+):
+    """Full per-block transition on a CLONE of `state`; returns
+    (post_state, epoch_context).
+
+    With verify_*=False the caller is expected to collect the block's
+    signature sets (signature_sets.get_block_signature_sets) and verify
+    them in one batched dispatch — the verifyBlock.ts:152+178 flow.
+    """
+    t = get_types(p).phase0
+    block = signed_block.message
+    post = clone_state(p, state)
+    ctx = process_slots(p, cfg, post, block.slot, ctx)
+
+    if verify_proposer_signature:
+        from ..crypto.bls.verifier import PyBlsVerifier
+        from .signature_sets import block_proposer_signature_set
+
+        s = block_proposer_signature_set(p, ctx, post, signed_block)
+        if not PyBlsVerifier().verify_signature_sets([s]):
+            raise StateTransitionError("invalid block proposer signature")
+
+    try:
+        process_block(p, cfg, ctx, post, block, verify_signatures)
+    except BlockProcessingError as e:
+        raise StateTransitionError(str(e)) from e
+
+    if verify_state_root:
+        actual = t.BeaconState.hash_tree_root(post)
+        if actual != block.state_root:
+            raise StateTransitionError(
+                f"state root mismatch: block {block.state_root.hex()} != computed {actual.hex()}"
+            )
+    return post, ctx
