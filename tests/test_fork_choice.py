@@ -1,0 +1,179 @@
+"""Fork-choice unit tests: head tracking, reorg, justified updates, pruning.
+
+Modeled on packages/fork-choice/test/unit (protoArray + forkChoice suites).
+"""
+
+import numpy as np
+import pytest
+
+from lodestar_tpu.fork_choice import (
+    Checkpoint,
+    ForkChoice,
+    ForkChoiceError,
+    ForkChoiceStore,
+    ProtoArray,
+    ProtoNode,
+    VoteTracker,
+    compute_deltas,
+)
+
+
+def root(n: int) -> bytes:
+    return n.to_bytes(32, "big")
+
+
+def node(slot, r, parent, j=0, f=0) -> ProtoNode:
+    return ProtoNode(
+        slot=slot,
+        block_root=root(r),
+        parent_root=root(parent) if parent is not None else None,
+        state_root=root(r),
+        target_root=root(r),
+        justified_epoch=j,
+        finalized_epoch=f,
+    )
+
+
+def make_fc(n_validators=16, balance=32):
+    store = ForkChoiceStore(
+        current_slot=0,
+        justified_checkpoint=Checkpoint(0, root(0)),
+        finalized_checkpoint=Checkpoint(0, root(0)),
+        justified_balances=np.full(n_validators, balance, dtype=np.int64),
+    )
+    anchor = node(0, 0, None)
+    return ForkChoice(store, anchor)
+
+
+class TestComputeDeltas:
+    def test_vote_moves(self):
+        indices = {root(1): 0, root(2): 1}
+        votes = [VoteTracker(current_root=root(1), next_root=root(2), next_epoch=1)]
+        deltas = compute_deltas(indices, votes, np.array([10]), np.array([10]))
+        assert list(deltas) == [-10, 10]
+        # vote settled: second call is a no-op
+        deltas = compute_deltas(indices, votes, np.array([10]), np.array([10]))
+        assert list(deltas) == [0, 0]
+
+    def test_balance_change(self):
+        indices = {root(1): 0}
+        votes = [VoteTracker(current_root=root(1), next_root=root(1), next_epoch=1)]
+        deltas = compute_deltas(indices, votes, np.array([10]), np.array([16]))
+        assert list(deltas) == [6]
+
+
+class TestHeadAndReorg:
+    def test_linear_chain_head(self):
+        fc = make_fc()
+        fc.on_block(1, root(1), root(0), root(1), root(1), Checkpoint(0, root(0)), Checkpoint(0, root(0)))
+        fc.on_block(2, root(2), root(1), root(2), root(2), Checkpoint(0, root(0)), Checkpoint(0, root(0)))
+        assert fc.update_head() == root(2)
+
+    def test_fork_resolved_by_votes(self):
+        fc = make_fc()
+        cp = Checkpoint(0, root(0))
+        fc.on_block(1, root(1), root(0), root(1), root(1), cp, cp)
+        # two children of 1
+        fc.on_block(2, root(2), root(1), root(2), root(2), cp, cp)
+        fc.on_block(2, root(3), root(1), root(3), root(3), cp, cp)
+        # 3 validators vote for block 2, 5 for block 3
+        fc.on_attestation([0, 1, 2], root(2), 1)
+        fc.on_attestation([3, 4, 5, 6, 7], root(3), 1)
+        assert fc.update_head() == root(3)
+        # votes move: now 6 validators prefer block 2 -> reorg
+        fc.on_attestation([3, 4, 5, 8, 9, 10], root(2), 2)
+        assert fc.update_head() == root(2)
+
+    def test_tie_break_higher_root_wins(self):
+        fc = make_fc()
+        cp = Checkpoint(0, root(0))
+        fc.on_block(1, root(5), root(0), root(5), root(5), cp, cp)
+        fc.on_block(1, root(9), root(0), root(9), root(9), cp, cp)
+        assert fc.update_head() == root(9)
+
+    def test_unknown_parent_rejected(self):
+        fc = make_fc()
+        with pytest.raises(ForkChoiceError):
+            fc.on_block(1, root(7), root(99), root(7), root(7), Checkpoint(0, root(0)), Checkpoint(0, root(0)))
+
+    def test_proposer_boost(self):
+        # boost = 40% of one slot's committee weight = 0.4*total/32; with
+        # 128 validators that outweighs a single 32-unit vote
+        fc = make_fc(n_validators=128)
+        cp = Checkpoint(0, root(0))
+        fc.on_block(1, root(1), root(0), root(1), root(1), cp, cp)
+        fc.on_block(2, root(2), root(1), root(2), root(2), cp, cp)
+        fc.on_block(2, root(3), root(1), root(3), root(3), cp, cp, is_timely_proposal=True)
+        # one vote for 2; boost should still favor 3
+        fc.on_attestation([0], root(2), 1)
+        assert fc.update_head() == root(3)
+        # boost expires next slot; the vote then wins
+        fc.update_time(3)
+        assert fc.update_head() == root(2)
+
+
+class TestJustifiedUpdates:
+    def test_justified_checkpoint_moves_head_filter(self):
+        fc = make_fc()
+        cp0 = Checkpoint(0, root(0))
+        fc.on_block(1, root(1), root(0), root(1), root(1), cp0, cp0)
+        fc.on_block(2, root(2), root(1), root(2), root(2), cp0, cp0)
+        # block 3 carries a newer justified checkpoint pointing at block 1
+        cp1 = Checkpoint(1, root(1))
+        fc.on_block(3, root(3), root(2), root(3), root(3), cp1, cp0)
+        head = fc.update_head()
+        assert head == root(3)
+        assert fc.store.justified_checkpoint.epoch == 1
+
+
+class TestPrune:
+    def test_prune_below_threshold_noop(self):
+        fc = make_fc()
+        cp = Checkpoint(0, root(0))
+        fc.on_block(1, root(1), root(0), root(1), root(1), cp, cp)
+        assert fc.prune(root(1)) == []
+
+    def test_prune_drops_ancestors(self):
+        fc = make_fc()
+        fc.proto.prune_threshold = 0
+        cp = Checkpoint(0, root(0))
+        for i in range(1, 6):
+            fc.on_block(i, root(i), root(i - 1), root(i), root(i), cp, cp)
+        removed = fc.prune(root(3))
+        assert [n.block_root for n in removed] == [root(0), root(1), root(2)]
+        assert not fc.has_block(root(1))
+        assert fc.has_block(root(4))
+        # structure still intact
+        fc.store.justified_checkpoint = Checkpoint(0, root(3))
+        assert fc.update_head() == root(5)
+
+    def test_get_ancestor(self):
+        fc = make_fc()
+        cp = Checkpoint(0, root(0))
+        for i in range(1, 5):
+            fc.on_block(i, root(i), root(i - 1), root(i), root(i), cp, cp)
+        assert fc.get_ancestor(root(4), 2) == root(2)
+        assert fc.is_descendant(root(1), root(4))
+        assert not fc.is_descendant(root(4), root(1))
+
+
+class TestOptimisticSync:
+    def test_invalid_execution_excluded_from_head(self):
+        fc = make_fc()
+        cp = Checkpoint(0, root(0))
+        fc.on_block(1, root(1), root(0), root(1), root(1), cp, cp, execution_status="syncing")
+        fc.on_block(2, root(2), root(1), root(2), root(2), cp, cp, execution_status="syncing")
+        fc.on_block(2, root(3), root(1), root(3), root(3), cp, cp, execution_status="syncing")
+        fc.on_attestation([0, 1, 2], root(2), 1)
+        assert fc.update_head() == root(2)
+        fc.on_invalid_execution(root(2))
+        assert fc.update_head() == root(3)
+
+    def test_valid_execution_marks_ancestors(self):
+        fc = make_fc()
+        cp = Checkpoint(0, root(0))
+        fc.on_block(1, root(1), root(0), root(1), root(1), cp, cp, execution_status="syncing")
+        fc.on_block(2, root(2), root(1), root(2), root(2), cp, cp, execution_status="syncing")
+        fc.on_valid_execution(root(2))
+        assert fc.get_block(root(1)).execution_status == "valid"
+        assert fc.get_block(root(2)).execution_status == "valid"
