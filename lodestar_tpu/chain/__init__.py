@@ -1,0 +1,8 @@
+"""Chain orchestration layer: verifier pool, block pipeline, clock, caches.
+
+Reference: packages/beacon-node/src/chain (SURVEY §2.4).
+"""
+
+from .bls_pool import BlsBatchPool  # noqa: F401
+from .clock import LocalClock  # noqa: F401
+from .emitter import ChainEvent, ChainEventEmitter  # noqa: F401

@@ -1,0 +1,9 @@
+"""Metrics registry + beacon metric groups.
+
+Reference: packages/beacon-node/src/metrics (prom-client registry,
+metrics/metrics/lodestar.ts metric definitions, server/http.ts exposition).
+Built on prometheus_client (in the image); a no-op fallback keeps the
+package importable without it.
+"""
+
+from .registry import Metrics, MetricsRegistry, create_metrics  # noqa: F401

@@ -1,0 +1,121 @@
+"""Prometheus-backed metrics registry.
+
+Reference: packages/beacon-node/src/metrics/metrics/lodestar.ts (the
+framework-internal metric groups; blsThreadPool.* at :385 is the model for
+the device-pool metrics here) and metrics/server/http.ts (exposition).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+try:  # prometheus_client is present in the image; gate anyway
+    from prometheus_client import (
+        CollectorRegistry,
+        Counter,
+        Gauge,
+        Histogram,
+        generate_latest,
+    )
+
+    HAVE_PROM = True
+except Exception:  # pragma: no cover
+    HAVE_PROM = False
+
+
+class _NoopMetric:
+    def labels(self, *a, **k):
+        return self
+
+    def inc(self, *a, **k):
+        pass
+
+    def dec(self, *a, **k):
+        pass
+
+    def set(self, *a, **k):
+        pass
+
+    def observe(self, *a, **k):
+        pass
+
+
+class MetricsRegistry:
+    """Thin factory over a CollectorRegistry."""
+
+    def __init__(self):
+        self.registry = CollectorRegistry() if HAVE_PROM else None
+
+    def counter(self, name: str, help: str, labels: Sequence[str] = ()):
+        if not HAVE_PROM:
+            return _NoopMetric()
+        return Counter(name, help, labelnames=list(labels), registry=self.registry)
+
+    def gauge(self, name: str, help: str, labels: Sequence[str] = ()):
+        if not HAVE_PROM:
+            return _NoopMetric()
+        return Gauge(name, help, labelnames=list(labels), registry=self.registry)
+
+    def histogram(self, name: str, help: str, buckets, labels: Sequence[str] = ()):
+        if not HAVE_PROM:
+            return _NoopMetric()
+        return Histogram(name, help, labelnames=list(labels), buckets=buckets, registry=self.registry)
+
+    def expose(self) -> bytes:
+        """Prometheus text exposition (server/http.ts GET /metrics body)."""
+        if not HAVE_PROM:
+            return b""
+        return generate_latest(self.registry)
+
+
+class Metrics:
+    """The framework's metric groups (subset of lodestar.ts, grown as
+    subsystems land)."""
+
+    def __init__(self):
+        self.reg = MetricsRegistry()
+        r = self.reg
+        # device BLS pool (blsThreadPool.* analog, lodestar.ts:385)
+        self.bls_pool_queue_length = r.gauge(
+            "lodestar_bls_pool_queue_length", "pending signature sets in the device pool"
+        )
+        self.bls_pool_dispatches_total = r.counter(
+            "lodestar_bls_pool_dispatches_total", "device batch-verify dispatches"
+        )
+        self.bls_pool_sets_total = r.counter(
+            "lodestar_bls_pool_sets_total", "signature sets verified", labels=("result",)
+        )
+        self.bls_pool_batch_size = r.histogram(
+            "lodestar_bls_pool_batch_size",
+            "live sets per dispatch",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+        )
+        self.bls_pool_dispatch_seconds = r.histogram(
+            "lodestar_bls_pool_dispatch_seconds",
+            "device dispatch latency",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5),
+        )
+        self.bls_pool_job_wait_seconds = r.histogram(
+            "lodestar_bls_pool_job_wait_seconds",
+            "time a set waits in the buffer before dispatch",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1),
+        )
+        # chain
+        self.block_processing_seconds = r.histogram(
+            "lodestar_block_processing_seconds",
+            "verifyBlock+importBlock wall time",
+            buckets=(0.01, 0.05, 0.1, 0.5, 1, 5, 10),
+        )
+        self.head_slot = r.gauge("lodestar_head_slot", "fork-choice head slot")
+        self.finalized_epoch = r.gauge("lodestar_finalized_epoch", "finalized checkpoint epoch")
+        # gossip queues (gossip/validation/queue.ts analog)
+        self.gossip_queue_length = r.gauge(
+            "lodestar_gossip_queue_length", "pending gossip jobs", labels=("topic",)
+        )
+        self.gossip_queue_dropped_total = r.counter(
+            "lodestar_gossip_queue_dropped_total", "dropped gossip jobs", labels=("topic",)
+        )
+
+
+def create_metrics() -> Metrics:
+    return Metrics()

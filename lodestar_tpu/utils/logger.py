@@ -1,0 +1,50 @@
+"""Module-scoped loggers.
+
+Reference: packages/utils/src/logger/winston.ts (winston with per-module
+child loggers).  Here: stdlib logging with the same shape — a root
+"lodestar" logger, ``get_logger(module)`` children, one-line timestamped
+format, level from env LODESTAR_LOG_LEVEL.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Optional
+
+_ROOT_NAME = "lodestar"
+_configured = False
+
+
+def _configure_root(level: Optional[str] = None) -> logging.Logger:
+    global _configured
+    root = logging.getLogger(_ROOT_NAME)
+    if not _configured:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter(
+                fmt="%(asctime)s.%(msecs)03d %(levelname)-7s [%(name)s] %(message)s",
+                datefmt="%b-%d %H:%M:%S",
+            )
+        )
+        root.addHandler(handler)
+        root.propagate = False
+        root.setLevel((level or os.environ.get("LODESTAR_LOG_LEVEL", "INFO")).upper())
+        _configured = True
+    return root
+
+
+def get_logger(module: str = "", level: Optional[str] = None) -> logging.Logger:
+    """Child logger named ``lodestar.<module>`` (winston childLogger analog)."""
+    root = _configure_root(level)
+    if not module:
+        return root
+    logger = root.getChild(module)
+    if level:
+        logger.setLevel(level.upper())
+    return logger
+
+
+def set_level(level: str) -> None:
+    _configure_root().setLevel(level.upper())

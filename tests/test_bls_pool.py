@@ -1,0 +1,132 @@
+"""BlsBatchPool tests: merged dispatches, retry-individually, metrics.
+
+Reference behaviors under test: multithread/index.ts:41-57 buffering,
+worker.ts:78-88 per-job retry after merged-batch failure.
+"""
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain.bls_pool import BlsBatchPool
+from lodestar_tpu.crypto.bls.api import interop_secret_key
+from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier, SingleSignatureSet
+from lodestar_tpu.metrics import create_metrics
+
+
+def make_set(i, valid=True):
+    sk = interop_secret_key(i)
+    msg = bytes([i % 256]) * 32
+    signer = sk if valid else interop_secret_key(i + 100)
+    return SingleSignatureSet(
+        pubkey=sk.to_public_key(),
+        signing_root=msg,
+        signature=signer.sign(msg).to_bytes(),
+    )
+
+
+class CountingVerifier(PyBlsVerifier):
+    def __init__(self):
+        super().__init__()
+        self.calls = []
+
+    def verify_signature_sets(self, sets):
+        self.calls.append(len(sets))
+        return super().verify_signature_sets(sets)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestPool:
+    def test_concurrent_jobs_merge_into_one_dispatch(self):
+        async def main():
+            v = CountingVerifier()
+            pool = BlsBatchPool(v, max_buffer_wait=0.01, metrics=create_metrics())
+            jobs = [pool.verify_signature_sets([make_set(i)]) for i in range(4)]
+            results = await asyncio.gather(*jobs)
+            assert results == [True] * 4
+            assert len(v.calls) == 1 and v.calls[0] == 4  # one merged dispatch
+            pool.close()
+
+        run(main())
+
+    def test_bad_job_retried_individually(self):
+        async def main():
+            v = CountingVerifier()
+            pool = BlsBatchPool(v, max_buffer_wait=0.01)
+            jobs = [
+                pool.verify_signature_sets([make_set(0)]),
+                pool.verify_signature_sets([make_set(1, valid=False)]),
+                pool.verify_signature_sets([make_set(2)]),
+            ]
+            results = await asyncio.gather(*jobs)
+            assert results == [True, False, True]
+            assert pool.batch_retries == 1
+            # 1 merged + 3 individual retries
+            assert v.calls == [3, 1, 1, 1]
+            pool.close()
+
+        run(main())
+
+    def test_flush_threshold_triggers_immediately(self):
+        async def main():
+            v = CountingVerifier()
+            pool = BlsBatchPool(v, max_buffer_wait=5.0, flush_threshold=3)
+            jobs = [pool.verify_signature_sets([make_set(i)]) for i in range(3)]
+            results = await asyncio.wait_for(asyncio.gather(*jobs), timeout=2.0)
+            assert results == [True] * 3
+            pool.close()
+
+        run(main())
+
+    def test_non_batchable_direct(self):
+        async def main():
+            v = CountingVerifier()
+            pool = BlsBatchPool(v, max_buffer_wait=5.0)
+            ok = await pool.verify_signature_sets([make_set(5)], batchable=False)
+            assert ok and v.calls == [1]
+            pool.close()
+
+        run(main())
+
+    def test_empty_job_false(self):
+        async def main():
+            pool = BlsBatchPool(CountingVerifier())
+            assert not await pool.verify_signature_sets([])
+            pool.close()
+
+        run(main())
+
+
+class TestUtilsExtras:
+    def test_logger_children(self):
+        from lodestar_tpu.utils.logger import get_logger
+
+        a = get_logger("chain")
+        b = get_logger("network")
+        assert a.name.endswith("chain") and b.name.endswith("network")
+        a.info("hello from test")
+
+    def test_retry(self):
+        from lodestar_tpu.utils.retry import retry
+
+        attempts = []
+
+        async def flaky(attempt):
+            attempts.append(attempt)
+            if attempt < 3:
+                raise ValueError("boom")
+            return "ok"
+
+        assert run(retry(flaky, retries=5)) == "ok"
+        assert attempts == [1, 2, 3]
+
+    def test_metrics_exposition(self):
+        m = create_metrics()
+        m.bls_pool_dispatches_total.inc()
+        m.head_slot.set(42)
+        text = m.reg.expose().decode()
+        assert "lodestar_bls_pool_dispatches_total" in text
+        assert "lodestar_head_slot 42.0" in text
