@@ -1,0 +1,230 @@
+"""BeaconChain: the orchestrator tying STF + fork choice + the batched
+verifier boundary together.
+
+Reference: packages/beacon-node/src/chain/chain.ts:58 (BeaconChain),
+blocks/verifyBlock.ts:45 (verify flow: sanity -> STF with deferred sigs ->
+one batched signature-set verification) and blocks/importBlock.ts:76
+(fork-choice import + head update).
+
+This is the minimum end-to-end core (SURVEY §7 step 6): network/sync/api
+attach on top; regen here is a simple block-root -> post-state cache (the
+queued regenerator with db replay is a later layer).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..config.chain_config import ChainConfig
+from ..fork_choice import Checkpoint, ForkChoice, ForkChoiceStore, ProtoNode
+from ..params import Preset
+from ..ssz import Fields
+from ..state_transition import (
+    EpochContext,
+    clone_state,
+    compute_epoch_at_slot,
+    compute_start_slot_at_epoch,
+    get_block_signature_sets,
+    process_slots,
+    state_transition,
+)
+from ..types import get_types
+from .bls_pool import BlsBatchPool
+from .emitter import ChainEvent, ChainEventEmitter
+from ..utils.logger import get_logger
+
+logger = get_logger("chain")
+
+
+class BlockError(Exception):
+    pass
+
+
+class BeaconChain:
+    def __init__(
+        self,
+        preset: Preset,
+        cfg: ChainConfig,
+        genesis_state,
+        bls_pool: BlsBatchPool,
+        metrics=None,
+    ):
+        self.p = preset
+        self.cfg = cfg
+        self.bls = bls_pool
+        self.metrics = metrics
+        self.emitter = ChainEventEmitter()
+        self.t = get_types(preset).phase0
+
+        # anchor: genesis (or checkpoint) state + implied block header
+        self.genesis_state = genesis_state
+        header = Fields(**{k: genesis_state.latest_block_header[k] for k in genesis_state.latest_block_header.keys()})
+        if header.state_root == b"\x00" * 32:
+            header.state_root = self.t.BeaconState.hash_tree_root(genesis_state)
+        anchor_root = self.t.BeaconBlockHeader.hash_tree_root(header)
+
+        balances = np.array(
+            [v.effective_balance for v in genesis_state.validators], dtype=np.int64
+        )
+        anchor_epoch = compute_epoch_at_slot(preset, genesis_state.slot)
+        cp = Checkpoint(anchor_epoch, anchor_root)
+        store = ForkChoiceStore(
+            current_slot=genesis_state.slot,
+            justified_checkpoint=cp,
+            finalized_checkpoint=cp,
+            justified_balances=balances,
+        )
+        self.fork_choice = ForkChoice(
+            store,
+            ProtoNode(
+                slot=genesis_state.slot,
+                block_root=anchor_root,
+                parent_root=None,
+                state_root=header.state_root,
+                target_root=anchor_root,
+                justified_epoch=anchor_epoch,
+                finalized_epoch=anchor_epoch,
+            ),
+            proposer_boost_pct=cfg.PROPOSER_SCORE_BOOST,
+        )
+        # state caches (stateCache/stateContextCache.ts analog, simple dict v1)
+        self.states_by_block_root: Dict[bytes, object] = {anchor_root: genesis_state}
+        self.ctx_by_block_root: Dict[bytes, EpochContext] = {}
+        self.head_root = anchor_root
+        self.blocks: Dict[bytes, object] = {}
+
+    # -- queries --------------------------------------------------------------
+
+    def head_state(self):
+        return self.states_by_block_root[self.head_root]
+
+    def get_state_by_block_root(self, root: bytes):
+        return self.states_by_block_root.get(root)
+
+    # -- block import (verifyBlock + importBlock) ------------------------------
+
+    async def process_block(self, signed_block, *, proposer_sig_verified: bool = False) -> bytes:
+        t0 = time.monotonic()
+        block = signed_block.message
+        block_root = self.t.BeaconBlock.hash_tree_root(block)
+
+        # sanity (verifyBlockSanityChecks, verifyBlock.ts:80-121)
+        if self.fork_choice.has_block(block_root):
+            return block_root  # duplicate import is a no-op
+        parent_root = bytes(block.parent_root)
+        if not self.fork_choice.has_block(parent_root):
+            raise BlockError(f"unknown parent {parent_root.hex()}")
+        pre_state = self.states_by_block_root.get(parent_root)
+        if pre_state is None:
+            raise BlockError("missing pre-state for parent (regen not available)")
+
+        # STF with all signature checks deferred (verifyBlock.ts:152)
+        post, ctx = state_transition(
+            self.p,
+            self.cfg,
+            pre_state,
+            signed_block,
+            verify_proposer_signature=False,
+            verify_signatures=False,
+            verify_state_root=True,
+        )
+
+        # one batched signature verification (verifyBlock.ts:177-190)
+        pre_at_slot = clone_state(self.p, pre_state)
+        pre_ctx = process_slots(self.p, self.cfg, pre_at_slot, block.slot)
+        sets = get_block_signature_sets(
+            self.p, self.cfg, pre_ctx, pre_at_slot, signed_block,
+            include_proposer=not proposer_sig_verified,
+        )
+        if sets and not await self.bls.verify_signature_sets(sets):
+            raise BlockError("block signature sets failed batch verification")
+
+        # import (importBlock.ts:76)
+        target_epoch = compute_epoch_at_slot(self.p, block.slot)
+        target_root = self._target_root(post, block_root, target_epoch)
+        justified = Checkpoint(
+            post.current_justified_checkpoint.epoch, bytes(post.current_justified_checkpoint.root)
+        )
+        finalized = Checkpoint(
+            post.finalized_checkpoint.epoch, bytes(post.finalized_checkpoint.root)
+        )
+        balances = np.array([v.effective_balance for v in post.validators], dtype=np.int64)
+        old_finalized = self.fork_choice.store.finalized_checkpoint.epoch
+        self.fork_choice.on_block(
+            block.slot,
+            block_root,
+            parent_root,
+            bytes(block.state_root),
+            target_root,
+            justified,
+            finalized,
+            justified_balances=balances,
+            is_timely_proposal=True,
+        )
+        # per-attestation fork-choice votes (importBlock.ts:144)
+        for att in block.body.attestations:
+            try:
+                indices = pre_ctx.get_attesting_indices(att.data, att.aggregation_bits)
+            except ValueError:
+                continue
+            if self.fork_choice.has_block(bytes(att.data.beacon_block_root)):
+                self.fork_choice.on_attestation(
+                    indices, bytes(att.data.beacon_block_root), att.data.target.epoch
+                )
+
+        self.states_by_block_root[block_root] = post
+        self.ctx_by_block_root[block_root] = ctx
+        self.blocks[block_root] = signed_block
+
+        old_head = self.head_root
+        self.head_root = self.fork_choice.update_head()
+        self.emitter.emit(ChainEvent.BLOCK, signed_block, block_root)
+        if self.head_root != old_head:
+            self.emitter.emit(ChainEvent.HEAD, self.head_root)
+        if finalized.epoch > old_finalized:
+            self.emitter.emit(ChainEvent.FINALIZED, finalized)
+        if self.metrics:
+            self.metrics.block_processing_seconds.observe(time.monotonic() - t0)
+            self.metrics.head_slot.set(block.slot)
+            self.metrics.finalized_epoch.set(finalized.epoch)
+        return block_root
+
+    def _target_root(self, post, block_root: bytes, target_epoch: int) -> bytes:
+        boundary_slot = compute_start_slot_at_epoch(self.p, target_epoch)
+        if boundary_slot >= post.slot:
+            return block_root
+        return bytes(post.block_roots[boundary_slot % self.p.SLOTS_PER_HISTORICAL_ROOT])
+
+    # -- block production (chain/factory/block/index.ts:21) --------------------
+
+    def produce_block_body(self, attestations: Sequence = ()) -> object:
+        body = self.t.BeaconBlockBody.default()
+        body.attestations = list(attestations)
+        return body
+
+    def produce_block(self, slot: int, randao_reveal: bytes, attestations: Sequence = ()):
+        """Assemble an unsigned block on top of the current head."""
+        head_state = self.head_state()
+        pre = clone_state(self.p, head_state)
+        ctx = process_slots(self.p, self.cfg, pre, slot)
+        proposer = ctx.get_beacon_proposer(slot)
+        body = self.produce_block_body(attestations)
+        body.randao_reveal = randao_reveal
+        body.eth1_data = pre.eth1_data
+        block = Fields(
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=self.t.BeaconBlockHeader.hash_tree_root(pre.latest_block_header),
+            state_root=b"\x00" * 32,
+            body=body,
+        )
+        unsigned = Fields(message=block, signature=b"\x00" * 96)
+        post, _ = state_transition(
+            self.p, self.cfg, head_state, unsigned,
+            verify_proposer_signature=False, verify_signatures=False, verify_state_root=False,
+        )
+        block.state_root = self.t.BeaconState.hash_tree_root(post)
+        return block, proposer

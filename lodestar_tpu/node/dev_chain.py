@@ -1,0 +1,147 @@
+"""DevChain: single-process interop chain — genesis, block production with
+inline interop validators, attestation flow, batched signature verification,
+fork-choice head tracking.  Networking stubbed by construction.
+
+Reference: the `lodestar dev` command (cli/src/cmds/dev/) and the
+single-node sim test (beacon-node/test/sim/, SURVEY §4.4): interop genesis,
+every validator key local, blocks produced and imported in-process.  This
+exercises the complete north-star path: signature-set collectors ->
+BlsBatchPool -> (Py|Tpu)BlsVerifier in one dispatch per block.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..chain.beacon_chain import BeaconChain
+from ..chain.bls_pool import BlsBatchPool
+from ..chain.clock import LocalClock
+from ..config.chain_config import ChainConfig
+from ..crypto.bls.api import SecretKey, aggregate_signatures, interop_secret_key
+from ..params import (
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_RANDAO,
+    Preset,
+)
+from ..ssz import Fields, uint64
+from ..state_transition import (
+    clone_state,
+    compute_epoch_at_slot,
+    compute_signing_root,
+    compute_start_slot_at_epoch,
+    get_domain,
+    interop_genesis_state,
+    process_slots,
+)
+from ..types import get_types
+from ..utils.logger import get_logger
+
+logger = get_logger("dev-chain")
+
+
+class DevChain:
+    def __init__(
+        self,
+        preset: Preset,
+        cfg: ChainConfig,
+        validator_count: int,
+        bls_pool: BlsBatchPool,
+        genesis_time: int = 0,
+        metrics=None,
+    ):
+        self.p = preset
+        self.cfg = cfg
+        self.t = get_types(preset).phase0
+        self.keys: Dict[int, SecretKey] = {
+            i: interop_secret_key(i) for i in range(validator_count)
+        }
+        genesis = interop_genesis_state(preset, cfg, validator_count, genesis_time or 1)
+        self.chain = BeaconChain(preset, cfg, genesis, bls_pool, metrics=metrics)
+        self.clock = LocalClock(
+            genesis_time or 1, cfg.SECONDS_PER_SLOT, preset.SLOTS_PER_EPOCH
+        )
+        self.pending_attestations: List = []
+
+    # -- inline validator duties (validator/src/services analogs) -------------
+
+    def _sign_randao(self, state, proposer: int, epoch: int) -> bytes:
+        domain = get_domain(self.p, state, DOMAIN_RANDAO, epoch)
+        root = compute_signing_root(self.p, uint64, epoch, domain)
+        return self.keys[proposer].sign(root).to_bytes()
+
+    def _sign_block(self, state, block, proposer: int) -> bytes:
+        epoch = compute_epoch_at_slot(self.p, block.slot)
+        domain = get_domain(self.p, state, DOMAIN_BEACON_PROPOSER, epoch)
+        root = compute_signing_root(self.p, self.t.BeaconBlock, block, domain)
+        return self.keys[proposer].sign(root).to_bytes()
+
+    def attest(self, slot: int) -> None:
+        """All committees of `slot` attest to the current head (the
+        AttestationService at 1/3-slot, validator/services/attestation.ts:22,
+        collapsed to full participation)."""
+        head_root = self.chain.head_root
+        head_state = self.chain.head_state()
+        state = clone_state(self.p, head_state)
+        ctx = process_slots(self.p, self.cfg, state, max(slot, state.slot))
+        epoch = compute_epoch_at_slot(self.p, slot)
+        target_root = self._epoch_boundary_root(state, head_root, epoch)
+        domain = get_domain(self.p, state, DOMAIN_BEACON_ATTESTER, epoch)
+        committees = ctx.get_committee_count_per_slot(epoch)
+        for index in range(committees):
+            committee = ctx.get_beacon_committee(slot, index)
+            data = Fields(
+                slot=slot,
+                index=index,
+                beacon_block_root=head_root,
+                source=state.current_justified_checkpoint,
+                target=Fields(epoch=epoch, root=target_root),
+            )
+            root = compute_signing_root(self.p, self.t.AttestationData, data, domain)
+            sigs = [self.keys[int(vi)].sign(root) for vi in committee]
+            att = Fields(
+                aggregation_bits=[True] * len(committee),
+                data=data,
+                signature=aggregate_signatures(sigs).to_bytes(),
+            )
+            self.pending_attestations.append(att)
+
+    def _epoch_boundary_root(self, state, head_root: bytes, epoch: int) -> bytes:
+        boundary_slot = compute_start_slot_at_epoch(self.p, epoch)
+        if boundary_slot >= state.slot:
+            return head_root
+        return bytes(state.block_roots[boundary_slot % self.p.SLOTS_PER_HISTORICAL_ROOT])
+
+    # -- slot driver ----------------------------------------------------------
+
+    async def advance_slot(self, slot: int, with_attestations: bool = True) -> bytes:
+        """Produce + import the block for `slot`; then attest on the new
+        head for inclusion at slot+1."""
+        atts = [
+            a
+            for a in self.pending_attestations
+            if a.data.slot + self.p.MIN_ATTESTATION_INCLUSION_DELAY <= slot
+        ][: self.p.MAX_ATTESTATIONS]
+        head_state = self.chain.head_state()
+        pre = clone_state(self.p, head_state)
+        ctx = process_slots(self.p, self.cfg, pre, slot)
+        proposer = ctx.get_beacon_proposer(slot)
+        epoch = compute_epoch_at_slot(self.p, slot)
+        randao = self._sign_randao(pre, proposer, epoch)
+        block, _ = self.chain.produce_block(slot, randao, attestations=atts)
+        sig = self._sign_block(pre, block, proposer)
+        signed = Fields(message=block, signature=sig)
+        root = await self.chain.process_block(signed)
+        self.pending_attestations = [
+            a for a in self.pending_attestations if a not in atts
+        ]
+        if with_attestations:
+            self.attest(slot)
+        logger.debug("slot %d: head %s", slot, root.hex()[:12])
+        return root
+
+    async def run(self, n_slots: int, with_attestations: bool = True) -> None:
+        state = self.chain.head_state()
+        start = state.slot + 1
+        for slot in range(start, start + n_slots):
+            await self.advance_slot(slot, with_attestations)
