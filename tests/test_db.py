@@ -1,0 +1,112 @@
+"""DB layer tests: controllers (memory + sqlite), repositories, BeaconDb."""
+
+import pytest
+
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.db import BeaconDb, Bucket, MemoryDbController, SqliteDbController
+from lodestar_tpu.db.schema import uint_key
+from lodestar_tpu.params import MINIMAL
+from lodestar_tpu.ssz import Fields
+from lodestar_tpu.state_transition import interop_genesis_state
+from lodestar_tpu.types import get_types
+
+CFG = ChainConfig(PRESET_BASE="minimal", MIN_GENESIS_TIME=0, MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=4)
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def controller(request, tmp_path):
+    if request.param == "memory":
+        c = MemoryDbController()
+    else:
+        c = SqliteDbController(str(tmp_path / "db.sqlite"))
+    yield c
+    c.close()
+
+
+class TestController:
+    def test_put_get_delete(self, controller):
+        controller.put(b"a", b"1")
+        assert controller.get(b"a") == b"1"
+        controller.put(b"a", b"2")
+        assert controller.get(b"a") == b"2"
+        controller.delete(b"a")
+        assert controller.get(b"a") is None
+
+    def test_ordered_entries_and_ranges(self, controller):
+        for i in (3, 1, 2, 9, 5):
+            controller.put(bytes([i]), bytes([i * 10]))
+        assert [k for k, _ in controller.entries()] == [bytes([i]) for i in (1, 2, 3, 5, 9)]
+        assert [k for k, _ in controller.entries(gte=bytes([2]), lt=bytes([9]))] == [
+            bytes([2]),
+            bytes([3]),
+            bytes([5]),
+        ]
+        assert [k for k, _ in controller.entries(reverse=True, limit=2)] == [bytes([9]), bytes([5])]
+
+    def test_batch(self, controller):
+        controller.batch_put([(b"x", b"1"), (b"y", b"2")])
+        assert controller.get(b"y") == b"2"
+        controller.batch_delete([b"x", b"y"])
+        assert controller.get(b"x") is None
+
+
+class TestSqlitePersistence:
+    def test_survives_reopen(self, tmp_path):
+        path = str(tmp_path / "persist.sqlite")
+        c = SqliteDbController(path)
+        c.put(b"key", b"value")
+        c.close()
+        c2 = SqliteDbController(path)
+        assert c2.get(b"key") == b"value"
+        c2.close()
+
+
+class TestBeaconDb:
+    def test_block_roundtrip(self):
+        t = get_types(MINIMAL).phase0
+        db = BeaconDb(MINIMAL)
+        blk = t.SignedBeaconBlock.default()
+        blk.message.slot = 7
+        root = t.BeaconBlock.hash_tree_root(blk.message)
+        db.block.put(root, blk)
+        got = db.block.get(root)
+        assert got.message.slot == 7
+        assert db.block.has(root)
+
+    def test_archive_by_slot_with_root_index(self):
+        t = get_types(MINIMAL).phase0
+        db = BeaconDb(MINIMAL)
+        roots = []
+        for slot in (5, 3, 8):
+            blk = t.SignedBeaconBlock.default()
+            blk.message.slot = slot
+            root = t.BeaconBlock.hash_tree_root(blk.message)
+            roots.append(root)
+            db.archive_block(blk, root)
+        # slot-ordered iteration
+        slots = [b.message.slot for b in db.block_archive.values()]
+        assert slots == [3, 5, 8]
+        # root index lookup
+        got = db.get_archived_block_by_root(roots[0])
+        assert got.message.slot == 5
+        # range query
+        assert [b.message.slot for b in db.archived_blocks_by_slot_range(4, 9)] == [5, 8]
+
+    def test_state_archive(self):
+        db = BeaconDb(MINIMAL)
+        state = interop_genesis_state(MINIMAL, CFG, 4)
+        db.archive_state(state)
+        state2 = interop_genesis_state(MINIMAL, CFG, 4)
+        state2.slot = 16
+        db.archive_state(state2)
+        assert db.last_archived_slot() == 16
+        assert db.last_archived_state().slot == 16
+
+    def test_op_pool_persistence(self):
+        t = get_types(MINIMAL).phase0
+        db = BeaconDb(MINIMAL)
+        exit_ = t.SignedVoluntaryExit.default()
+        exit_.message.validator_index = 11
+        db.voluntary_exit.put(uint_key(11), exit_)
+        vals = list(db.voluntary_exit.values())
+        assert len(vals) == 1 and vals[0].message.validator_index == 11
