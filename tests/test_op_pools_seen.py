@@ -1,0 +1,147 @@
+"""Op pool + seen cache tests (chain/opPools + chain/seenCache analogs)."""
+
+import pytest
+
+from lodestar_tpu.chain.op_pools import AggregatedAttestationPool, AttestationPool, OpPool
+from lodestar_tpu.chain.seen_cache import (
+    SeenAggregatedAttestations,
+    SeenAttesters,
+    SeenBlockProposers,
+    SeenSyncCommitteeMessages,
+)
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.crypto.bls.api import aggregate_signatures, interop_secret_key
+from lodestar_tpu.params import MINIMAL
+from lodestar_tpu.ssz import Fields
+from lodestar_tpu.state_transition import interop_genesis_state
+from lodestar_tpu.types import get_types
+
+T = get_types(MINIMAL).phase0
+CFG = ChainConfig(PRESET_BASE="minimal", MIN_GENESIS_TIME=0, MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=8)
+
+
+def att_data(slot=1, index=0, root=b"\x01" * 32):
+    return Fields(
+        slot=slot,
+        index=index,
+        beacon_block_root=root,
+        source=Fields(epoch=0, root=b"\x00" * 32),
+        target=Fields(epoch=0, root=root),
+    )
+
+
+def single_att(bit, n=4, slot=1, signer=0):
+    bits = [i == bit for i in range(n)]
+    sk = interop_secret_key(signer)
+    return Fields(aggregation_bits=bits, data=att_data(slot=slot), signature=sk.sign(b"\x01" * 32).to_bytes())
+
+
+class TestAttestationPool:
+    def test_add_and_aggregate(self):
+        pool = AttestationPool(MINIMAL)
+        for i in range(3):
+            assert pool.add(single_att(i, signer=i)) == "added"
+        data_root = T.AttestationData.hash_tree_root(att_data())
+        agg = pool.get_aggregate(1, data_root)
+        assert agg.aggregation_bits == [True, True, True, False]
+
+    def test_subset_dedup(self):
+        pool = AttestationPool(MINIMAL)
+        pool.add(single_att(0))
+        assert pool.add(single_att(0)) == "already_known"
+
+    def test_prune(self):
+        pool = AttestationPool(MINIMAL)
+        pool.add(single_att(0, slot=1))
+        pool.prune(clock_slot=10)
+        assert pool.get_aggregate(1, T.AttestationData.hash_tree_root(att_data())) is None
+
+
+class TestAggregatedPool:
+    def test_block_packing_prefers_fresh_and_recent(self):
+        pool = AggregatedAttestationPool(MINIMAL)
+        state = interop_genesis_state(MINIMAL, CFG, 8)
+        state.slot = 6
+        # old, low participation
+        a1 = Fields(aggregation_bits=[True, False, False, False], data=att_data(slot=1), signature=b"\x00" * 96)
+        # recent, high participation
+        a2 = Fields(aggregation_bits=[True, True, True, False], data=att_data(slot=5, root=b"\x02" * 32), signature=b"\x00" * 96)
+        pool.add(a1)
+        pool.add(a2)
+        picked = pool.get_attestations_for_block(state)
+        assert picked[0] is a2
+
+    def test_group_cap(self):
+        pool = AggregatedAttestationPool(MINIMAL)
+        for k in range(4):
+            bits = [i <= k for i in range(8)]
+            pool.add(Fields(aggregation_bits=bits, data=att_data(), signature=b"\x00" * 96))
+        root = T.AttestationData.hash_tree_root(att_data())
+        group = pool._by_slot[1][root]
+        assert len(group) == AggregatedAttestationPool.MAX_PER_GROUP
+        # the best (most bits) kept
+        assert sum(group[0].aggregation_bits) == 4
+
+
+class TestOpPool:
+    def test_exits_filtered_and_persisted(self):
+        from lodestar_tpu.db import BeaconDb
+
+        pool = OpPool(MINIMAL)
+        state = interop_genesis_state(MINIMAL, CFG, 8)
+        e = T.SignedVoluntaryExit.default()
+        e.message.validator_index = 3
+        pool.add_voluntary_exit(e)
+        _, _, exits = pool.get_slashings_and_exits(state)
+        assert len(exits) == 1
+        # persist + reload
+        db = BeaconDb(MINIMAL)
+        pool.to_db(db)
+        pool2 = OpPool(MINIMAL)
+        pool2.from_db(db)
+        assert 3 in pool2.voluntary_exits
+
+    def test_exited_validator_excluded(self):
+        pool = OpPool(MINIMAL)
+        state = interop_genesis_state(MINIMAL, CFG, 8)
+        state.validators[3].exit_epoch = 5  # already exiting
+        e = T.SignedVoluntaryExit.default()
+        e.message.validator_index = 3
+        pool.add_voluntary_exit(e)
+        _, _, exits = pool.get_slashings_and_exits(state)
+        assert exits == []
+
+
+class TestSeenCaches:
+    def test_seen_attesters(self):
+        seen = SeenAttesters()
+        assert not seen.is_known(5, 1)
+        seen.add(5, 1)
+        assert seen.is_known(5, 1)
+        seen.add(9, 2)  # prunes epoch 5 (retention 2)
+        assert not seen.is_known(5, 1)
+
+    def test_seen_proposers(self):
+        seen = SeenBlockProposers()
+        seen.add(10, 3)
+        assert seen.is_known(10, 3)
+        assert not seen.is_known(11, 3)
+
+    def test_aggregated_superset_dedup(self):
+        seen = SeenAggregatedAttestations()
+        root = b"\x05" * 32
+        seen.add(1, root, [True, True, False, False])
+        # subset -> known
+        assert seen.is_known(1, root, [True, False, False, False])
+        # equal -> known
+        assert seen.is_known(1, root, [True, True, False, False])
+        # superset -> new
+        assert not seen.is_known(1, root, [True, True, True, False])
+        seen.add(1, root, [True, True, True, False])
+        assert seen.is_known(1, root, [True, True, False, False])
+
+    def test_sync_committee_seen(self):
+        seen = SeenSyncCommitteeMessages()
+        seen.add(3, 0, 7)
+        assert seen.is_known(3, 0, 7)
+        assert not seen.is_known(3, 1, 7)
