@@ -1,0 +1,208 @@
+"""Gossip validation fn tests (chain/validation analogs).
+
+Fixtures come from a short dev chain so states/committees/fork-choice are
+real; verification flows through BlsBatchPool like production.
+"""
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain.bls_pool import BlsBatchPool
+from lodestar_tpu.chain.op_pools import OpPool
+from lodestar_tpu.chain.seen_cache import (
+    SeenAggregatedAttestations,
+    SeenAggregators,
+    SeenAttesters,
+    SeenBlockProposers,
+)
+from lodestar_tpu.chain.validation import (
+    GossipAction,
+    GossipValidationError,
+    validate_gossip_attestation,
+    validate_gossip_block,
+    validate_gossip_voluntary_exit,
+)
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.node.dev_chain import DevChain
+from lodestar_tpu.params import MINIMAL, DOMAIN_BEACON_ATTESTER
+from lodestar_tpu.ssz import Fields
+from lodestar_tpu.state_transition import (
+    clone_state,
+    compute_epoch_at_slot,
+    compute_signing_root,
+    get_domain,
+    process_slots,
+)
+from lodestar_tpu.types import get_types
+
+CFG = ChainConfig(
+    PRESET_BASE="minimal", SHARD_COMMITTEE_PERIOD=0, MIN_GENESIS_TIME=0,
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=32,
+)
+T = get_types(MINIMAL).phase0
+
+
+class Env:
+    def __init__(self, dev, pool):
+        self.dev = dev
+        self.pool = pool
+        self.state = clone_state(dev.p, dev.chain.head_state())
+        self.ctx = process_slots(dev.p, CFG, self.state, self.state.slot + 1)
+
+
+@pytest.fixture(scope="module")
+def env():
+    async def build():
+        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        dev = DevChain(MINIMAL, CFG, 32, pool)
+        await dev.run(2, with_attestations=False)
+        return Env(dev, pool)
+
+    return asyncio.run(build())
+
+
+def make_attestation(env, bit=0, slot=None, committee_index=0, bad_sig=False):
+    dev = env.dev
+    slot = slot if slot is not None else env.state.slot
+    committee = env.ctx.get_beacon_committee(slot, committee_index)
+    epoch = compute_epoch_at_slot(dev.p, slot)
+    data = Fields(
+        slot=slot,
+        index=committee_index,
+        beacon_block_root=dev.chain.head_root,
+        source=env.state.current_justified_checkpoint,
+        target=Fields(epoch=epoch, root=dev.chain.head_root),
+    )
+    domain = get_domain(dev.p, env.state, DOMAIN_BEACON_ATTESTER, epoch)
+    root = compute_signing_root(dev.p, T.AttestationData, data, domain)
+    signer = int(committee[bit]) if not bad_sig else 31
+    sig = dev.keys[signer].sign(root)
+    bits = [i == bit for i in range(len(committee))]
+    return Fields(aggregation_bits=bits, data=data, signature=sig.to_bytes())
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestAttestationValidation:
+    def _validate(self, env, att, seen=None, clock=None):
+        return validate_gossip_attestation(
+            MINIMAL, CFG,
+            attestation=att,
+            subnet=None,
+            clock_slot=clock if clock is not None else att.data.slot,
+            fork_choice=env.dev.chain.fork_choice,
+            seen_attesters=seen or SeenAttesters(),
+            ctx=env.ctx,
+            state=env.state,
+            pool=env.pool,
+        )
+
+    def test_valid_accepted(self, env):
+        att = make_attestation(env)
+        indices = run(self._validate(env, att))
+        assert len(indices) == 1
+
+    def test_two_bits_rejected(self, env):
+        att = make_attestation(env)
+        att.aggregation_bits = [True, True] + att.aggregation_bits[2:]
+        with pytest.raises(GossipValidationError) as e:
+            run(self._validate(env, att))
+        assert e.value.action == GossipAction.REJECT
+
+    def test_unknown_block_ignored(self, env):
+        att = make_attestation(env)
+        att.data.beacon_block_root = b"\x66" * 32
+        with pytest.raises(GossipValidationError) as e:
+            run(self._validate(env, att))
+        assert e.value.action == GossipAction.IGNORE
+
+    def test_seen_attester_ignored(self, env):
+        att = make_attestation(env)
+        seen = SeenAttesters()
+        run(self._validate(env, att, seen=seen))
+        with pytest.raises(GossipValidationError) as e:
+            run(self._validate(env, att, seen=seen))
+        assert e.value.action == GossipAction.IGNORE
+
+    def test_bad_signature_rejected(self, env):
+        att = make_attestation(env, bad_sig=True)
+        with pytest.raises(GossipValidationError) as e:
+            run(self._validate(env, att))
+        assert e.value.code == "INVALID_SIGNATURE"
+
+    def test_old_slot_ignored(self, env):
+        att = make_attestation(env)
+        with pytest.raises(GossipValidationError) as e:
+            run(self._validate(env, att, clock=att.data.slot + 40))
+        assert e.value.action == GossipAction.IGNORE
+
+
+class TestBlockValidation:
+    def test_repeat_proposal_ignored(self, env):
+        dev = env.dev
+        slot = env.state.slot
+        pre = clone_state(dev.p, dev.chain.head_state())
+        ctx = process_slots(dev.p, CFG, pre, slot)
+        proposer = ctx.get_beacon_proposer(slot)
+        epoch = compute_epoch_at_slot(dev.p, slot)
+        randao = dev._sign_randao(pre, proposer, epoch)
+        block, _ = dev.chain.produce_block(slot, randao)
+        signed = Fields(message=block, signature=dev._sign_block(pre, block, proposer))
+        seen = SeenBlockProposers()
+
+        async def go():
+            await validate_gossip_block(
+                MINIMAL, CFG,
+                signed_block=signed, clock_slot=slot,
+                fork_choice=dev.chain.fork_choice,
+                seen_block_proposers=seen, ctx=ctx, state=pre, pool=env.pool,
+            )
+            # second time: repeat proposal
+            with pytest.raises(GossipValidationError) as e:
+                await validate_gossip_block(
+                    MINIMAL, CFG,
+                    signed_block=signed, clock_slot=slot,
+                    fork_choice=dev.chain.fork_choice,
+                    seen_block_proposers=seen, ctx=ctx, state=pre, pool=env.pool,
+                )
+            assert e.value.code == "REPEAT_PROPOSAL"
+
+        run(go())
+
+    def test_future_slot_ignored(self, env):
+        signed = Fields(message=Fields(slot=99, proposer_index=0, parent_root=b"\x00" * 32,
+                                       state_root=b"\x00" * 32, body=T.BeaconBlockBody.default()),
+                        signature=b"\x00" * 96)
+
+        async def go():
+            with pytest.raises(GossipValidationError) as e:
+                await validate_gossip_block(
+                    MINIMAL, CFG, signed_block=signed, clock_slot=5,
+                    fork_choice=env.dev.chain.fork_choice,
+                    seen_block_proposers=SeenBlockProposers(),
+                    ctx=env.ctx, state=env.state, pool=env.pool,
+                )
+            assert e.value.code == "FUTURE_SLOT"
+
+        run(go())
+
+
+class TestExitValidation:
+    def test_invalid_exit_rejected(self, env):
+        exit_ = T.SignedVoluntaryExit.default()
+        exit_.message.validator_index = 1
+        exit_.message.epoch = 99  # future epoch -> invalid
+
+        async def go():
+            with pytest.raises(GossipValidationError) as e:
+                await validate_gossip_voluntary_exit(
+                    MINIMAL, CFG, signed_exit=exit_,
+                    ctx=env.ctx, state=env.state, pool=env.pool, op_pool=OpPool(MINIMAL),
+                )
+            assert e.value.action == GossipAction.REJECT
+
+        run(go())
