@@ -51,19 +51,28 @@ class BeaconChain:
         genesis_state,
         bls_pool: BlsBatchPool,
         metrics=None,
+        clock=None,
     ):
         self.p = preset
         self.cfg = cfg
         self.bls = bls_pool
         self.metrics = metrics
+        self.clock = clock
         self.emitter = ChainEventEmitter()
         self.t = get_types(preset).phase0
+        from ..config.fork_config import ForkConfig
+
+        self.fork_config = ForkConfig(cfg)
 
         # anchor: genesis (or checkpoint) state + implied block header
         self.genesis_state = genesis_state
         header = Fields(**{k: genesis_state.latest_block_header[k] for k in genesis_state.latest_block_header.keys()})
         if header.state_root == b"\x00" * 32:
-            header.state_root = self.t.BeaconState.hash_tree_root(genesis_state)
+            from ..state_transition.upgrade import state_types
+
+            header.state_root = state_types(preset, genesis_state).BeaconState.hash_tree_root(
+                genesis_state
+            )
         anchor_root = self.t.BeaconBlockHeader.hash_tree_root(header)
 
         balances = np.array(
@@ -107,9 +116,11 @@ class BeaconChain:
     # -- block import (verifyBlock + importBlock) ------------------------------
 
     async def process_block(self, signed_block, *, proposer_sig_verified: bool = False) -> bytes:
+        from ..state_transition.upgrade import block_types
+
         t0 = time.monotonic()
         block = signed_block.message
-        block_root = self.t.BeaconBlock.hash_tree_root(block)
+        block_root = block_types(self.p, block).BeaconBlock.hash_tree_root(block)
 
         # sanity (verifyBlockSanityChecks, verifyBlock.ts:80-121)
         if self.fork_choice.has_block(block_root):
@@ -162,7 +173,7 @@ class BeaconChain:
             justified,
             finalized,
             justified_balances=balances,
-            is_timely_proposal=True,
+            is_timely_proposal=self._is_timely_proposal(block.slot),
         )
         # per-attestation fork-choice votes (importBlock.ts:144)
         for att in block.body.attestations:
@@ -192,6 +203,20 @@ class BeaconChain:
             self.metrics.finalized_epoch.set(finalized.epoch)
         return block_root
 
+    def _is_timely_proposal(self, block_slot: int) -> bool:
+        """Proposer boost gate (forkChoice onBlock): only a block for the
+        CURRENT clock slot arriving before the attestation deadline
+        (SECONDS_PER_SLOT / INTERVALS_PER_SLOT into the slot) earns the
+        boost.  Late blocks and replayed old blocks (sync) must not — the
+        ~40% committee-weight boost would otherwise be reorg-exploitable."""
+        from ..params import INTERVALS_PER_SLOT
+
+        if self.clock is None:
+            return False
+        if block_slot != self.clock.current_slot:
+            return False
+        return self.clock.seconds_into_slot() < self.cfg.SECONDS_PER_SLOT / INTERVALS_PER_SLOT
+
     def _target_root(self, post, block_root: bytes, target_epoch: int) -> bytes:
         boundary_slot = compute_start_slot_at_epoch(self.p, target_epoch)
         if boundary_slot >= post.slot:
@@ -200,18 +225,37 @@ class BeaconChain:
 
     # -- block production (chain/factory/block/index.ts:21) --------------------
 
-    def produce_block_body(self, attestations: Sequence = ()) -> object:
-        body = self.t.BeaconBlockBody.default()
+    G2_INFINITY_SIG = b"\xc0" + b"\x00" * 95
+
+    def produce_block_body(self, fork, attestations: Sequence = (), sync_aggregate=None) -> object:
+        from ..config.fork_config import ForkName
+        from ..types import get_types
+
+        t = getattr(get_types(self.p), fork.value)
+        body = t.BeaconBlockBody.default()
         body.attestations = list(attestations)
+        if fork != ForkName.phase0:
+            body.sync_aggregate = sync_aggregate or Fields(
+                sync_committee_bits=[False] * self.p.SYNC_COMMITTEE_SIZE,
+                sync_committee_signature=self.G2_INFINITY_SIG,
+            )
         return body
 
-    def produce_block(self, slot: int, randao_reveal: bytes, attestations: Sequence = ()):
-        """Assemble an unsigned block on top of the current head."""
+    def produce_block(
+        self, slot: int, randao_reveal: bytes, attestations: Sequence = (), sync_aggregate=None
+    ):
+        """Assemble an unsigned block on top of the current head, using the
+        body shape of the fork active at `slot`."""
+        from ..state_transition.upgrade import state_types
+
         head_state = self.head_state()
         pre = clone_state(self.p, head_state)
         ctx = process_slots(self.p, self.cfg, pre, slot)
         proposer = ctx.get_beacon_proposer(slot)
-        body = self.produce_block_body(attestations)
+        fork = self.fork_config.get_fork_info_at_epoch(
+            compute_epoch_at_slot(self.p, slot)
+        ).name
+        body = self.produce_block_body(fork, attestations, sync_aggregate)
         body.randao_reveal = randao_reveal
         body.eth1_data = pre.eth1_data
         block = Fields(
@@ -226,5 +270,5 @@ class BeaconChain:
             self.p, self.cfg, head_state, unsigned,
             verify_proposer_signature=False, verify_signatures=False, verify_state_root=False,
         )
-        block.state_root = self.t.BeaconState.hash_tree_root(post)
+        block.state_root = state_types(self.p, post).BeaconState.hash_tree_root(post)
         return block, proposer

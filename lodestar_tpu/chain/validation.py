@@ -85,6 +85,7 @@ async def validate_gossip_attestation(
         _reject("NOT_EXACTLY_ONE_BIT_SET")
     if not fork_choice.has_block(bytes(data.beacon_block_root)):
         _ignore("UNKNOWN_BEACON_BLOCK_ROOT")
+    _verify_head_block_and_target_root(p, fork_choice, data)
     if data.index >= ctx.get_committee_count_per_slot(target_epoch):
         _reject("COMMITTEE_INDEX_OUT_OF_RANGE")
     committee = ctx.get_beacon_committee(att_slot, data.index)
@@ -103,6 +104,29 @@ async def validate_gossip_attestation(
         _ignore("ATTESTATION_ALREADY_KNOWN")
     seen_attesters.add(target_epoch, attester)
     return [attester]
+
+
+def _verify_head_block_and_target_root(p: Preset, fork_choice, data) -> None:
+    """verifyHeadBlockAndTargetRoot (chain/validation/attestation.ts): the
+    attested head block must not be newer than the attestation slot, and the
+    attestation's target root must be the epoch-boundary ancestor of the
+    head block — otherwise the attestation's vote is internally inconsistent
+    and must be REJECTed (not re-gossiped).  Caller has already established
+    has_block(beacon_block_root).  Descent from the finalized checkpoint is
+    implied: proto-array pruning keeps only finalized descendants."""
+    head_root = bytes(data.beacon_block_root)
+    head_block = fork_choice.get_block(head_root)
+    if head_block.slot > data.slot:
+        _reject("HEAD_BLOCK_AFTER_ATTESTATION_SLOT")
+    target_start_slot = data.target.epoch * p.SLOTS_PER_EPOCH
+    if head_block.slot >= target_start_slot:
+        # target must be the head block's own chain checkpoint
+        expected = fork_choice.get_ancestor(head_root, target_start_slot)
+    else:
+        # head is from a prior epoch: target checkpoint root IS the head
+        expected = head_root
+    if expected != bytes(data.target.root):
+        _reject("BAD_TARGET_ROOT")
 
 
 def is_aggregator(p: Preset, committee_len: int, selection_proof: bytes) -> bool:
@@ -149,6 +173,7 @@ async def validate_gossip_aggregate_and_proof(
         _ignore("AGGREGATE_ALREADY_KNOWN")
     if not fork_choice.has_block(bytes(data.beacon_block_root)):
         _ignore("UNKNOWN_BEACON_BLOCK_ROOT")
+    _verify_head_block_and_target_root(p, fork_choice, data)
     committee = ctx.get_beacon_committee(data.slot, data.index)
     if aggregator not in [int(x) for x in committee]:
         _reject("AGGREGATOR_NOT_IN_COMMITTEE")
@@ -187,24 +212,36 @@ async def validate_gossip_block(
     ctx,
     state,
     pool,
+    clock=None,
 ) -> None:
     """Gossip beacon_block checks (gossip/handlers/index.ts:90): slot not
-    future, not finalized-old, first proposal for (slot, proposer), parent
-    known, proposer signature (verified on the spot — the reference uses
-    blsVerifyOnMainThread to keep gossip latency low; a non-batchable
-    dispatch is the analog)."""
+    future (with MAXIMUM_GOSSIP_CLOCK_DISPARITY tolerance when a clock is
+    supplied), not finalized-old, descends from the finalized checkpoint,
+    first proposal for (slot, proposer), parent known, proposer signature
+    (verified on the spot — the reference uses blsVerifyOnMainThread to
+    keep gossip latency low; a non-batchable dispatch is the analog)."""
     from ..state_transition.signature_sets import block_proposer_signature_set
 
     block = signed_block.message
     if block.slot > clock_slot:
-        _ignore("FUTURE_SLOT")
-    finalized_slot = fork_choice.store.finalized_checkpoint.epoch * p.SLOTS_PER_EPOCH
+        # allow the standard 500 ms clock disparity for blocks broadcast
+        # just before their slot starts (gossip/handlers/index.ts clock use)
+        if clock is None or not clock.is_current_slot_given_disparity(block.slot):
+            _ignore("FUTURE_SLOT")
+    finalized = fork_choice.store.finalized_checkpoint
+    finalized_slot = finalized.epoch * p.SLOTS_PER_EPOCH
     if block.slot <= finalized_slot:
         _ignore("WOULD_REVERT_FINALIZED_SLOT")
     if seen_block_proposers.is_known(block.slot, block.proposer_index):
         _ignore("REPEAT_PROPOSAL")
     if not fork_choice.has_block(bytes(block.parent_root)):
         _ignore("PARENT_UNKNOWN")
+    # a known parent at a non-finalized slot can still sit on a pruned-out
+    # branch: require actual descent from the finalized checkpoint root
+    if fork_choice.has_block(finalized.root) and not fork_choice.is_descendant(
+        finalized.root, bytes(block.parent_root)
+    ):
+        _reject("NOT_FINALIZED_DESCENDANT")
     expected_proposer = ctx.get_beacon_proposer(block.slot)
     if block.proposer_index != expected_proposer:
         _reject("INCORRECT_PROPOSER")
@@ -217,19 +254,26 @@ async def validate_gossip_block(
 async def validate_gossip_voluntary_exit(
     p: Preset, cfg: ChainConfig, *, signed_exit, ctx, state, pool, op_pool
 ) -> None:
-    idx = signed_exit.message.validator_index
+    exit_msg = signed_exit.message
+    idx = exit_msg.validator_index
     if idx in op_pool.voluntary_exits:
         _ignore("ALREADY_EXISTS")
-    from ..state_transition.block import BlockProcessingError, process_voluntary_exit
+    # read-only validity predicate — the reference's isValidVoluntaryExit
+    # with verifySignature=false never mutates state; a deepcopy dry-run
+    # here would copy the whole state per gossip message (DoS vector)
+    from ..params.presets import FAR_FUTURE_EPOCH
+    from ..state_transition.misc import is_active_validator
 
-    try:
-        # dry-run the state checks without mutating: validate on a shallow
-        # guard by catching the mutation path early via verify-only flow
-        import copy
-
-        probe = copy.deepcopy(state)
-        process_voluntary_exit(p, cfg, ctx, probe, signed_exit, verify_signatures=False)
-    except BlockProcessingError:
+    if idx >= len(state.validators):
+        _reject("INVALID_EXIT")
+    v = state.validators[idx]
+    current_epoch = compute_epoch_at_slot(p, state.slot)
+    if (
+        not is_active_validator(v, current_epoch)
+        or v.exit_epoch != FAR_FUTURE_EPOCH
+        or current_epoch < exit_msg.epoch
+        or current_epoch < v.activation_epoch + cfg.SHARD_COMMITTEE_PERIOD
+    ):
         _reject("INVALID_EXIT")
     if not await pool.verify_signature_sets(
         [voluntary_exit_signature_set(p, ctx, state, signed_exit)], batchable=True

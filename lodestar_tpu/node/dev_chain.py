@@ -57,10 +57,10 @@ class DevChain:
             i: interop_secret_key(i) for i in range(validator_count)
         }
         genesis = interop_genesis_state(preset, cfg, validator_count, genesis_time or 1)
-        self.chain = BeaconChain(preset, cfg, genesis, bls_pool, metrics=metrics)
         self.clock = LocalClock(
             genesis_time or 1, cfg.SECONDS_PER_SLOT, preset.SLOTS_PER_EPOCH
         )
+        self.chain = BeaconChain(preset, cfg, genesis, bls_pool, metrics=metrics, clock=self.clock)
         self.pending_attestations: List = []
 
     # -- inline validator duties (validator/src/services analogs) -------------
@@ -71,10 +71,47 @@ class DevChain:
         return self.keys[proposer].sign(root).to_bytes()
 
     def _sign_block(self, state, block, proposer: int) -> bytes:
+        from ..state_transition.upgrade import block_types
+
         epoch = compute_epoch_at_slot(self.p, block.slot)
         domain = get_domain(self.p, state, DOMAIN_BEACON_PROPOSER, epoch)
-        root = compute_signing_root(self.p, self.t.BeaconBlock, block, domain)
+        root = compute_signing_root(self.p, block_types(self.p, block).BeaconBlock, block, domain)
         return self.keys[proposer].sign(root).to_bytes()
+
+    def _sign_sync_aggregate(self, pre):
+        """Full-participation sync aggregate over the previous block root
+        (SyncCommitteeService collapsed, validator/services/syncCommittee.ts).
+        Returns None pre-altair; `pre` must be advanced to the block slot."""
+        from ..state_transition.upgrade import state_fork_name
+        from ..config.fork_config import ForkName
+        from ..state_transition.altair import sync_aggregate_signing_root
+
+        if state_fork_name(pre) == ForkName.phase0:
+            return None
+        pk2i = {bytes(interop_pubkey): i for i, interop_pubkey in self._pubkey_by_index().items()}
+        root = sync_aggregate_signing_root(self.p, pre)
+        sigs = []
+        bits = []
+        for pk in pre.current_sync_committee.pubkeys:
+            idx = pk2i.get(bytes(pk))
+            if idx is None:
+                bits.append(False)
+                continue
+            bits.append(True)
+            sigs.append(self.keys[idx].sign(root))
+        if not any(bits):
+            return None
+        return Fields(
+            sync_committee_bits=bits,
+            sync_committee_signature=aggregate_signatures(sigs).to_bytes(),
+        )
+
+    def _pubkey_by_index(self) -> Dict[int, bytes]:
+        if not hasattr(self, "_pubkeys_cache"):
+            self._pubkeys_cache = {
+                i: sk.to_public_key().to_bytes() for i, sk in self.keys.items()
+            }
+        return self._pubkeys_cache
 
     def attest(self, slot: int) -> None:
         """All committees of `slot` attest to the current head (the
@@ -128,7 +165,10 @@ class DevChain:
         proposer = ctx.get_beacon_proposer(slot)
         epoch = compute_epoch_at_slot(self.p, slot)
         randao = self._sign_randao(pre, proposer, epoch)
-        block, _ = self.chain.produce_block(slot, randao, attestations=atts)
+        sync_aggregate = self._sign_sync_aggregate(pre)
+        block, _ = self.chain.produce_block(
+            slot, randao, attestations=atts, sync_aggregate=sync_aggregate
+        )
         sig = self._sign_block(pre, block, proposer)
         signed = Fields(message=block, signature=sig)
         root = await self.chain.process_block(signed)

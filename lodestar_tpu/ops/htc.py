@@ -23,6 +23,7 @@ from typing import List, Tuple
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..crypto.bls import hash_to_curve as H
@@ -70,6 +71,7 @@ def hash_to_field_limbs(msgs: List[bytes], dst: bytes = H.DST_G2) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
+@jax.jit
 def fq2_is_square(a: jnp.ndarray) -> jnp.ndarray:
     """Legendre via the norm: a square in Fq2 iff (c0^2+c1^2)^((p-1)/2) != -1."""
     a0, a1 = a[..., 0, :], a[..., 1, :]
@@ -95,6 +97,7 @@ def _fq2_pow_static(a: jnp.ndarray, e: int) -> jnp.ndarray:
     return out
 
 
+@jax.jit
 def fq2_sqrt(a: jnp.ndarray) -> jnp.ndarray:
     """Square root for p % 4 == 3 (oracle Fq2.sqrt, branchless).
 
@@ -114,6 +117,7 @@ def fq2_sqrt(a: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(is_neg1[..., None, None], cand_a, cand_b)
 
 
+@jax.jit
 def fq2_sgn0(a: jnp.ndarray) -> jnp.ndarray:
     """RFC 9380 sgn0 for m=2 (oracle Fq2.sgn0): parity of c0, or of c1 when
     c0 == 0.  Needs the canonical residue, hence a full reduction."""
@@ -141,6 +145,7 @@ def _gprime(x: jnp.ndarray) -> jnp.ndarray:
     return fp_strict(fp_add(fp_add(x3, ax), jnp.broadcast_to(jnp.asarray(ISO_B), x.shape)))
 
 
+@jax.jit
 def map_to_curve_sswu(u: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Simplified SWU onto E' (oracle map_to_curve_sswu, select-based)."""
     z = jnp.broadcast_to(jnp.asarray(SSWU_Z), u.shape).astype(jnp.uint32)
@@ -209,6 +214,7 @@ def map_to_curve_g2(u: jnp.ndarray) -> Point:
     return (xm, ym, z)
 
 
+@jax.jit
 def hash_to_g2_device(u: jnp.ndarray) -> Point:
     """Device stage of hash_to_g2 (oracle hash_to_g2 after hash_to_field).
 

@@ -31,6 +31,7 @@ from typing import NamedTuple, Tuple
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -214,7 +215,8 @@ def point_double_complete(p: Point, ns: FieldNS) -> Point:
     zero — produced by cancellations in the redundant representation)
     canonicalizes to the exact infinity encoding."""
     out = point_double(p, ns)
-    degenerate = ns.is_zero_mod(p[1]) | ns.is_zero_mod(p[2])
+    zeros = ns.is_zero_mod(ns.stack([p[1], p[2]]))  # one stacked reduction
+    degenerate = jnp.any(zeros, axis=-1)
     inf = point_infinity(ns, batch_shape=degenerate.shape)
     return point_select(degenerate, inf, out, ns)
 
@@ -227,14 +229,25 @@ def point_add_complete(p: Point, q: Point, ns: FieldNS) -> Point:
     adversarial small-order points can drive intermediate results through
     2-torsion (y == 0) and produce z-residue zeros with nonzero digits; the
     exact-zero convention only covers deliberately constructed infinities.
+
+    All six residue-zero predicates (z1, z2, h, sdiff, y1 and the doubling
+    degeneracy) ride ONE stacked Barrett reduction — this function sits in
+    the body of every subgroup-check/cofactor scan, so per-instance graph
+    size is compile time (see limbs._fold_tail note).
     """
     x3, y3, z3, h, sdiff = _add_core(p, q, ns)
-    p_inf = ns.is_zero_mod(p[2])
-    q_inf = ns.is_zero_mod(q[2])
-    eq_x = ns.is_zero_mod(h)
-    eq_y = ns.is_zero_mod(sdiff)
-    dbl = point_double_complete(p, ns)
+    stacked = ns.stack([p[2], q[2], h, sdiff, p[1]])
+    zeros = ns.is_zero_mod(stacked)  # (..., 5) bools
+    axis = zeros.ndim - 1
+    p_inf = jnp.take(zeros, 0, axis=axis)
+    q_inf = jnp.take(zeros, 1, axis=axis)
+    eq_x = jnp.take(zeros, 2, axis=axis)
+    eq_y = jnp.take(zeros, 3, axis=axis)
+    y1_zero = jnp.take(zeros, 4, axis=axis)
+    # doubling arm with its degeneracy folded in (2-torsion / phantom inf)
+    dbl_raw = point_double(p, ns)
     inf = point_infinity(ns, batch_shape=p_inf.shape)
+    dbl = point_select(y1_zero | p_inf, inf, dbl_raw, ns)
     out = (x3, y3, z3)
     out = point_select(eq_x & ~eq_y & ~p_inf & ~q_inf, inf, out, ns)
     out = point_select(eq_x & eq_y & ~p_inf & ~q_inf, dbl, out, ns)
@@ -353,6 +366,7 @@ def point_to_affine(p: Point, ns: FieldNS):
     return xa, ya
 
 
+@jax.jit
 def psi(p: Point) -> Point:
     """Untwist-Frobenius-twist endomorphism on E2, jacobian-native:
     psi(X, Y, Z) = (conj(X) * cx, conj(Y) * cy, conj(Z)).
@@ -367,12 +381,14 @@ def psi(p: Point) -> Point:
     return (s[..., 0, :, :], s[..., 1, :, :], tw.fq2_conj(z))
 
 
+@jax.jit
 def g1_sigma(p: Point) -> Point:
     """sigma(X, Y, Z) = (beta X, Y, Z) — the G1 GLV endomorphism."""
     x, y, z = p
     return (fl.fp_mul(x, jnp.asarray(BETA)), y, z)
 
 
+@jax.jit
 def g1_subgroup_check(p: Point) -> jnp.ndarray:
     """P in G1 iff sigma(P) == [z^2 - 1]P (complete ladder: adversary picks P).
     Infinity passes.  Oracle: curve.g1_subgroup_check."""
@@ -381,6 +397,7 @@ def g1_subgroup_check(p: Point) -> jnp.ndarray:
     return ok | point_is_infinity(p, FQ_NS)
 
 
+@jax.jit
 def g2_subgroup_check(p: Point) -> jnp.ndarray:
     """P in G2 iff psi(P) == [z]P (z < 0: computed as [-z](-P)).
     Oracle: curve.g2_subgroup_check."""
@@ -389,6 +406,7 @@ def g2_subgroup_check(p: Point) -> jnp.ndarray:
     return ok | point_is_infinity(p, FQ2_NS)
 
 
+@jax.jit
 def g2_clear_cofactor(p: Point) -> Point:
     """Budroni-Pintore: h_eff P = [z^2-z-1]P + [z-1]psi(P) + psi^2([2]P).
     Oracle: curve.g2_clear_cofactor.  Complete adds: input is hash output

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from ..crypto.bls import fields as F
@@ -95,6 +96,7 @@ def fq12_from_oracle(v: F.Fq12) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
+@jax.jit
 def fq2_mul_many(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """K independent Fq2 products in one limb multiply.
 
@@ -118,6 +120,7 @@ def fq2_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return fq2_mul_many(a[..., None, :, :], b[..., None, :, :])[..., 0, :, :]
 
 
+@jax.jit
 def fq2_sqr(a: jnp.ndarray) -> jnp.ndarray:
     """(a0+a1)(a0-a1) + 2 a0 a1 u — two stacked muls."""
     a0, a1 = a[..., 0, :], a[..., 1, :]
@@ -144,6 +147,7 @@ def fq2_scale_fq(a: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
     return fp_mul(a, s[..., None, :])
 
 
+@jax.jit
 def fq2_inv(a: jnp.ndarray) -> jnp.ndarray:
     """1/(a0 + a1 u) = (a0 - a1 u) / (a0^2 + a1^2)."""
     a0, a1 = a[..., 0, :], a[..., 1, :]
@@ -154,10 +158,12 @@ def fq2_inv(a: jnp.ndarray) -> jnp.ndarray:
     return out
 
 
+@jax.jit
 def fq2_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return jnp.all(fl.fp_eq(a, b), axis=-1)
 
 
+@jax.jit
 def fq2_is_zero(a: jnp.ndarray) -> jnp.ndarray:
     return jnp.all(fl.fp_is_zero(a), axis=-1)
 
@@ -167,6 +173,7 @@ def fq2_is_zero(a: jnp.ndarray) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
+@jax.jit
 def fq6_mul_many(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """K independent Fq6 products: (..., K, 3, 2, 26) -> same shape.
 
@@ -210,6 +217,7 @@ def fq6_scale_fq2(a: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
     return fq2_mul_many(a, ss)
 
 
+@jax.jit
 def fq6_inv(a: jnp.ndarray) -> jnp.ndarray:
     a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
     sq = fq2_mul_many(jnp.stack([a0, a2, a1], axis=-3), jnp.stack([a0, a2, a1], axis=-3))
@@ -228,6 +236,7 @@ def fq6_inv(a: jnp.ndarray) -> jnp.ndarray:
     return fq6_scale_fq2(jnp.stack([t0, t1, t2], axis=-3), dinv)
 
 
+@jax.jit
 def fq6_frobenius(a: jnp.ndarray) -> jnp.ndarray:
     c0 = fq2_conj(a[..., 0, :, :])
     scaled = fq2_mul_many(
@@ -242,6 +251,7 @@ def fq6_frobenius(a: jnp.ndarray) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
+@jax.jit
 def fq12_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Karatsuba over Fq6: 3 Fq6 products = 18 Fq2 products, one limb mul."""
     a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
@@ -255,6 +265,7 @@ def fq12_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([c0, c1], axis=-4)
 
 
+@jax.jit
 def fq12_sqr(a: jnp.ndarray) -> jnp.ndarray:
     """(a0 + a1 w)^2 = (a0^2 + v a1^2) + 2 a0 a1 w, via Karatsuba:
     m = a0*a1; s = (a0+a1)(a0 + v*a1); c0 = s - m - v*m; c1 = 2m."""
@@ -273,6 +284,7 @@ def fq12_conj(a: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([a[..., 0, :, :, :], fp_neg(a[..., 1, :, :, :])], axis=-4)
 
 
+@jax.jit
 def fq12_frobenius(a: jnp.ndarray) -> jnp.ndarray:
     c0 = fq6_frobenius(a[..., 0, :, :, :])
     c1f = fq6_frobenius(a[..., 1, :, :, :])
@@ -281,6 +293,7 @@ def fq12_frobenius(a: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([c0, c1], axis=-4)
 
 
+@jax.jit
 def fq12_inv(a: jnp.ndarray) -> jnp.ndarray:
     a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
     t = fq6_mul_many(jnp.stack([a0, a1], axis=-4), jnp.stack([a0, a1], axis=-4))
@@ -298,6 +311,7 @@ def fq12_select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarra
     return jnp.where(cond[..., None, None, None, None], a, b)
 
 
+@jax.jit
 def fq12_is_one(a: jnp.ndarray) -> jnp.ndarray:
     one = jnp.asarray(FQ12_ONE)
     return jnp.all(fl.fp_eq(a, jnp.broadcast_to(one, a.shape)), axis=(-3, -2, -1))

@@ -420,6 +420,12 @@ class Fields:
     def __setattr__(self, k, v):
         self._d[k] = v
 
+    def __delattr__(self, k):
+        try:
+            del self._d[k]
+        except KeyError:
+            raise AttributeError(k) from None
+
     def __getitem__(self, k):
         return self._d[k]
 

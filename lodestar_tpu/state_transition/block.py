@@ -45,15 +45,31 @@ def _sha(b: bytes) -> bytes:
     return hashlib.sha256(b).digest()
 
 
-def process_block(p: Preset, cfg: ChainConfig, ctx: EpochContext, state, block, verify_signatures: bool = True) -> None:
+def process_block(p: Preset, cfg: ChainConfig, ctx: EpochContext, state, block, verify_signatures: bool = True, execution_engine=None) -> None:
+    """Fork-dispatching per-block processing (stateTransition.ts processBlock
+    + block/index.ts per-fork tables)."""
+    from .upgrade import ForkName, block_fork_name
+
+    fork = block_fork_name(block)
     process_block_header(p, ctx, state, block)
+    if fork == ForkName.bellatrix:
+        from .bellatrix import is_execution_enabled, process_execution_payload
+
+        if is_execution_enabled(p, state, block.body):
+            process_execution_payload(p, cfg, state, block.body, execution_engine)
     process_randao(p, cfg, ctx, state, block.body, verify_signatures)
     process_eth1_data(p, state, block.body)
-    process_operations(p, cfg, ctx, state, block.body, verify_signatures)
+    process_operations(p, cfg, ctx, state, block.body, verify_signatures, fork=fork)
+    if fork != ForkName.phase0:
+        from .altair import process_sync_aggregate
+
+        process_sync_aggregate(p, cfg, ctx, state, block.body.sync_aggregate, verify_signatures)
 
 
 def process_block_header(p: Preset, ctx: EpochContext, state, block) -> None:
-    t = get_types(p).phase0
+    from .upgrade import block_types
+
+    t = block_types(p, block)
     if block.slot != state.slot:
         raise BlockProcessingError("block slot != state slot")
     if block.slot <= state.latest_block_header.slot:
@@ -101,7 +117,9 @@ def process_eth1_data(p: Preset, state, body) -> None:
         state.eth1_data = body.eth1_data
 
 
-def process_operations(p: Preset, cfg: ChainConfig, ctx: EpochContext, state, body, verify_signatures: bool) -> None:
+def process_operations(p: Preset, cfg: ChainConfig, ctx: EpochContext, state, body, verify_signatures: bool, fork=None) -> None:
+    from .upgrade import ForkName
+
     expected_deposits = min(p.MAX_DEPOSITS, state.eth1_data.deposit_count - state.eth1_deposit_index)
     if len(body.deposits) != expected_deposits:
         raise BlockProcessingError("wrong deposit count in block")
@@ -110,7 +128,12 @@ def process_operations(p: Preset, cfg: ChainConfig, ctx: EpochContext, state, bo
     for op in body.attester_slashings:
         process_attester_slashing(p, cfg, ctx, state, op, verify_signatures)
     for op in body.attestations:
-        process_attestation(p, ctx, state, op, verify_signatures)
+        if fork is None or fork == ForkName.phase0:
+            process_attestation(p, ctx, state, op, verify_signatures)
+        else:
+            from .altair import process_attestation_altair
+
+            process_attestation_altair(p, cfg, ctx, state, op, verify_signatures)
     for op in body.deposits:
         process_deposit(p, cfg, ctx, state, op)
     for op in body.voluntary_exits:

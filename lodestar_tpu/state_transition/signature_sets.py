@@ -28,8 +28,10 @@ from .misc import compute_epoch_at_slot
 
 
 def block_proposer_signature_set(p: Preset, ctx: EpochContext, state, signed_block) -> SingleSignatureSet:
-    t = get_types(p).phase0
+    from .upgrade import block_types
+
     block = signed_block.message
+    t = block_types(p, block)
     epoch = compute_epoch_at_slot(p, block.slot)
     domain = get_domain(p, state, DOMAIN_BEACON_PROPOSER, epoch)
     return SingleSignatureSet(
@@ -100,6 +102,31 @@ def voluntary_exit_signature_set(p: Preset, ctx: EpochContext, state, signed_exi
     )
 
 
+def sync_aggregate_signature_set(p: Preset, ctx: EpochContext, state, sync_aggregate):
+    """Sync-aggregate set (signatureSets/syncCommittee.ts analog).  Returns
+    None when there are no participants and the signature is the G2
+    infinity point (eth_fast_aggregate_verify's valid-empty case) — nothing
+    to batch."""
+    from ..crypto.bls.api import PublicKey
+    from .altair import sync_aggregate_signing_root
+
+    bits = list(sync_aggregate.sync_committee_bits)
+    participant_pubkeys = [
+        bytes(pk) for pk, bit in zip(state.current_sync_committee.pubkeys, bits) if bit
+    ]
+    sig = bytes(sync_aggregate.sync_committee_signature)
+    if not participant_pubkeys:
+        # the only valid empty aggregate is the G2 infinity signature; the
+        # non-infinity case is rejected structurally in
+        # altair.process_sync_aggregate, so there is nothing to batch here
+        return None
+    return AggregatedSignatureSet(
+        pubkeys=[PublicKey.from_bytes(pk) for pk in participant_pubkeys],
+        signing_root=sync_aggregate_signing_root(p, state),
+        signature=sig,
+    )
+
+
 def get_block_signature_sets(
     p: Preset,
     cfg: ChainConfig,
@@ -127,4 +154,8 @@ def get_block_signature_sets(
     sets.extend(attestation_signature_sets(p, ctx, state, body.attestations))
     for signed_exit in body.voluntary_exits:
         sets.append(voluntary_exit_signature_set(p, ctx, state, signed_exit))
+    if hasattr(body, "sync_aggregate"):
+        s = sync_aggregate_signature_set(p, ctx, state, body.sync_aggregate)
+        if s is not None:
+            sets.append(s)
     return sets

@@ -11,12 +11,14 @@ import copy
 from typing import Optional
 
 from ..config.chain_config import ChainConfig
+from ..config.fork_config import ForkName
 from ..params import Preset
 from ..types import get_types
 from .block import BlockProcessingError, process_block
 from .epoch import process_epoch
 from .epoch_context import EpochContext
 from .misc import compute_epoch_at_slot
+from .upgrade import maybe_upgrade_state, state_fork_name, state_types
 
 
 class StateTransitionError(Exception):
@@ -32,7 +34,7 @@ def clone_state(p: Preset, state):
 
 def process_slot(p: Preset, state) -> None:
     """Cache state root + block root for the slot (spec process_slot)."""
-    t = get_types(p).phase0
+    t = state_types(p, state)
     prev_state_root = t.BeaconState.hash_tree_root(state)
     state.state_roots[state.slot % p.SLOTS_PER_HISTORICAL_ROOT] = prev_state_root
     if state.latest_block_header.state_root == b"\x00" * 32:
@@ -57,11 +59,19 @@ def process_slots(
     while state.slot < slot:
         process_slot(p, state)
         if (state.slot + 1) % p.SLOTS_PER_EPOCH == 0:
-            process_epoch(p, cfg, ctx, state)
+            if state_fork_name(state) == ForkName.phase0:
+                process_epoch(p, cfg, ctx, state)
+            else:
+                from .altair import process_epoch_altair
+
+                process_epoch_altair(p, cfg, ctx, state)
             state.slot += 1
             ctx = EpochContext.create_from_state(
                 p, state, ctx.pubkey2index, ctx.index2pubkey
             )
+            # fork upgrades fire on the first slot of their epoch
+            # (stateTransition.ts:100-144)
+            maybe_upgrade_state(p, cfg, ctx, state)
         else:
             state.slot += 1
     return ctx
@@ -84,10 +94,10 @@ def state_transition(
     signature sets (signature_sets.get_block_signature_sets) and verify
     them in one batched dispatch — the verifyBlock.ts:152+178 flow.
     """
-    t = get_types(p).phase0
     block = signed_block.message
     post = clone_state(p, state)
     ctx = process_slots(p, cfg, post, block.slot, ctx)
+    t = state_types(p, post)
 
     if verify_proposer_signature:
         from ..crypto.bls.verifier import PyBlsVerifier

@@ -244,6 +244,10 @@ def frame_uncompress(data: bytes) -> bytes:
             if body != _STREAM_ID[4:]:
                 raise ValueError("bad stream identifier")
             continue
+        if ctype in (0x00, 0x01) and clen < 4:
+            # chunk too short to carry its CRC — keep the module's
+            # ValueError convention (struct.error would leak to decoders)
+            raise ValueError("chunk body shorter than CRC")
         if ctype == 0x00:  # compressed
             crc = struct.unpack("<I", body[:4])[0]
             chunk = uncompress(body[4:])

@@ -1,0 +1,85 @@
+"""Fork-schedule e2e: DevChain crosses phase0 -> altair -> bellatrix and
+finalizes, with sync aggregates verified through the batch boundary.
+
+Reference model: stateTransition.ts:100-144 fork dispatch +
+slot/upgradeStateToAltair.ts / upgradeStateToBellatrix.ts; sim-test
+precedent asserts finality against real components
+(test/sim/multiNodeSingleThread.test.ts).
+"""
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain.bls_pool import BlsBatchPool
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.config.fork_config import ForkName
+from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.node.dev_chain import DevChain
+from lodestar_tpu.params import MINIMAL
+from lodestar_tpu.state_transition.upgrade import state_fork_name
+
+CFG = ChainConfig(
+    PRESET_BASE="minimal",
+    SHARD_COMMITTEE_PERIOD=0,
+    MIN_GENESIS_TIME=0,
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=32,
+    ALTAIR_FORK_EPOCH=1,
+    BELLATRIX_FORK_EPOCH=2,
+)
+N_VALIDATORS = 32
+
+
+def test_dev_chain_crosses_altair_and_bellatrix_and_finalizes():
+    async def main():
+        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        dev = DevChain(MINIMAL, CFG, N_VALIDATORS, pool)
+
+        # genesis era: phase0
+        assert state_fork_name(dev.chain.head_state()) == ForkName.phase0
+
+        # run 6 epochs: upgrade at epoch 1 (altair) and 2 (bellatrix),
+        # then finalize on participation-flag justification
+        await dev.run(6 * MINIMAL.SLOTS_PER_EPOCH + 2)
+
+        state = dev.chain.head_state()
+        assert state_fork_name(state) == ForkName.bellatrix
+        assert bytes(state.fork.current_version) == CFG.BELLATRIX_FORK_VERSION
+        assert bytes(state.fork.previous_version) == CFG.ALTAIR_FORK_VERSION
+        # altair machinery is live
+        assert len(state.current_sync_committee.pubkeys) == MINIMAL.SYNC_COMMITTEE_SIZE
+        assert len(state.inactivity_scores) == N_VALIDATORS
+        assert any(int(f) != 0 for f in state.previous_epoch_participation)
+        # bellatrix pre-merge: payload header still default
+        assert bytes(state.latest_execution_payload_header.block_hash) == b"\x00" * 32
+        # finality across the fork boundary
+        assert state.current_justified_checkpoint.epoch >= 4, "no justification"
+        assert state.finalized_checkpoint.epoch >= 3, "no finalization"
+        # sync aggregates carried real participation
+        head_block = dev.chain.blocks[dev.chain.head_root].message
+        bits = list(head_block.body.sync_aggregate.sync_committee_bits)
+        assert any(bits), "sync aggregate has no participants"
+        pool.close()
+
+    asyncio.run(main())
+
+
+def test_altair_upgrade_state_shape():
+    """The upgraded state hashes/serializes under the altair schema."""
+
+    async def main():
+        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        dev = DevChain(MINIMAL, CFG, N_VALIDATORS, pool)
+        await dev.run(MINIMAL.SLOTS_PER_EPOCH + 1)
+        state = dev.chain.head_state()
+        assert state_fork_name(state) == ForkName.altair
+        assert not hasattr(state, "previous_epoch_attestations")
+        from lodestar_tpu.state_transition.upgrade import state_types
+
+        t = state_types(MINIMAL, state)
+        blob = t.BeaconState.serialize(state)
+        rt = t.BeaconState.deserialize(blob)
+        assert t.BeaconState.hash_tree_root(rt) == t.BeaconState.hash_tree_root(state)
+        pool.close()
+
+    asyncio.run(main())
