@@ -104,10 +104,10 @@ class TpuBlsVerifier:
         n = len(sets)
         b = self._bucket(n)
         self.padding_wasted += b - n
-        pk_x = np.zeros((b, fl.NLIMBS), dtype=np.uint32)
-        pk_y = np.zeros((b, fl.NLIMBS), dtype=np.uint32)
-        sig_x = np.zeros((b, 2, fl.NLIMBS), dtype=np.uint32)
-        sig_y = np.zeros((b, 2, fl.NLIMBS), dtype=np.uint32)
+        pk_x = np.zeros((b, fl.NLIMBS), dtype=fl.NP_DTYPE)
+        pk_y = np.zeros((b, fl.NLIMBS), dtype=fl.NP_DTYPE)
+        sig_x = np.zeros((b, 2, fl.NLIMBS), dtype=fl.NP_DTYPE)
+        sig_y = np.zeros((b, 2, fl.NLIMBS), dtype=fl.NP_DTYPE)
         msgs = []
         for i, s in enumerate(sets):
             pk = get_aggregated_pubkey(s)
@@ -137,7 +137,7 @@ class TpuBlsVerifier:
         msg_u = htc.hash_to_field_limbs(msgs)
         coeffs = [secrets.randbits(64) | 1 for _ in range(b)]
         bits = np.array(
-            [[(c >> j) & 1 for j in range(64)] for c in coeffs], dtype=np.uint32
+            [[(c >> j) & 1 for j in range(64)] for c in coeffs], dtype=fl.NP_DTYPE
         )
         mask = np.zeros(b, dtype=bool)
         mask[:n] = True

@@ -7,7 +7,7 @@ fixed-shape, branchless (select-based), batch-first JAX: one device dispatch
 verifies a whole batch of signature sets.
 
 Layering (bottom-up):
-- ``limbs``        Fq arithmetic over 16-bit limb arrays (uint32 lanes)
+- ``limbs``        Fq arithmetic over 8-bit digit arrays (float32 lanes)
 - ``tower``        Fq2 / Fq6 / Fq12 extension towers as stacked limb arrays
 - ``points``       G1/G2 jacobian point kernels, endomorphisms, subgroup checks
 - ``pairing``      inversion-free Miller loop + final exponentiation

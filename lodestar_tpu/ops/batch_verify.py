@@ -103,10 +103,10 @@ def example_inputs(n: int = 8) -> tuple:
     from ..crypto.bls.api import interop_secret_key
     from ..crypto.bls.hash_to_curve import hash_to_g2
 
-    pk_x = np.zeros((n, fl.NLIMBS), dtype=np.uint32)
-    pk_y = np.zeros((n, fl.NLIMBS), dtype=np.uint32)
-    sig_x = np.zeros((n, 2, fl.NLIMBS), dtype=np.uint32)
-    sig_y = np.zeros((n, 2, fl.NLIMBS), dtype=np.uint32)
+    pk_x = np.zeros((n, fl.NLIMBS), dtype=fl.NP_DTYPE)
+    pk_y = np.zeros((n, fl.NLIMBS), dtype=fl.NP_DTYPE)
+    sig_x = np.zeros((n, 2, fl.NLIMBS), dtype=fl.NP_DTYPE)
+    sig_y = np.zeros((n, 2, fl.NLIMBS), dtype=fl.NP_DTYPE)
     msgs = []
     for i in range(n):
         sk = interop_secret_key(i)
@@ -121,6 +121,6 @@ def example_inputs(n: int = 8) -> tuple:
     msg_u = htc.hash_to_field_limbs(msgs)
     rng = np.random.default_rng(7)
     coeffs = [int(rng.integers(1, 1 << 63)) * 2 + 1 for _ in range(n)]
-    bits = np.array([[(c >> i) & 1 for i in range(64)] for c in coeffs], dtype=np.uint32)
+    bits = np.array([[(c >> i) & 1 for i in range(64)] for c in coeffs], dtype=fl.NP_DTYPE)
     mask = np.ones(n, dtype=bool)
     return (pk_x, pk_y, sig_x, sig_y, msg_u, bits, mask)

@@ -44,10 +44,12 @@ NEG_B_OVER_A = tw.fq2_const(-H.ISO_B * H.ISO_A.inv())
 B_OVER_ZA = tw.fq2_const(H.ISO_B * (H.SSWU_Z * H.ISO_A).inv())
 MINUS_ONE_FQ2 = tw.fq2_const(H.Fq2(P_INT - 1, 0))
 
-K1 = np.stack([tw.fq2_const(c) for c in H._K1])  # x_num, degree 3
-K2 = np.stack([tw.fq2_const(c) for c in H._K2])  # x_den, degree 2 monic
-K3 = np.stack([tw.fq2_const(c) for c in H._K3])  # y_num, degree 3
-K4 = np.stack([tw.fq2_const(c) for c in H._K4])  # y_den, degree 3 monic
+# Lists of stable per-coefficient arrays (constant-stability rule,
+# ops/limbs.py RED_ROWS): _eval_poly hands these to jnp at trace time.
+K1 = [tw.fq2_const(c) for c in H._K1]  # x_num, degree 3
+K2 = [tw.fq2_const(c) for c in H._K2]  # x_den, degree 2 monic
+K3 = [tw.fq2_const(c) for c in H._K3]  # y_num, degree 3
+K4 = [tw.fq2_const(c) for c in H._K4]  # y_den, degree 3 monic
 
 
 # ---------------------------------------------------------------------------
@@ -58,7 +60,7 @@ K4 = np.stack([tw.fq2_const(c) for c in H._K4])  # y_den, degree 3 monic
 def hash_to_field_limbs(msgs: List[bytes], dst: bytes = H.DST_G2) -> np.ndarray:
     """Host stage: sha256 expand + reduce (oracle hash_to_field_fq2), packed
     as (N, 2, 2, 26) — two Fq2 draws per message."""
-    out = np.zeros((len(msgs), 2, 2, fl.NLIMBS), dtype=np.uint32)
+    out = np.zeros((len(msgs), 2, 2, fl.NLIMBS), dtype=fl.NP_DTYPE)
     for i, m in enumerate(msgs):
         u0, u1 = H.hash_to_field_fq2(m, 2, dst)
         out[i, 0] = tw.fq2_const(u0)
@@ -92,7 +94,7 @@ def _fq2_pow_static(a: jnp.ndarray, e: int) -> jnp.ndarray:
         r = jnp.where(bit.astype(bool)[..., None, None], tw.fq2_mul(r, a), r)
         return r, None
 
-    init = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE), a.shape).astype(jnp.uint32)
+    init = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE), a.shape).astype(fl.DTYPE)
     out, _ = lax.scan(body, init, bits)
     return out
 
@@ -111,7 +113,7 @@ def fq2_sqrt(a: jnp.ndarray) -> jnp.ndarray:
     # branch A: i * x0 = (-x0.c1, x0.c0)
     cand_a = jnp.stack([fl.fp_neg(x0[..., 1, :]), x0[..., 0, :]], axis=-2)
     # branch B: (alpha + 1)^((p-1)/2) * x0
-    one = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE), alpha.shape).astype(jnp.uint32)
+    one = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE), alpha.shape).astype(fl.DTYPE)
     b = _fq2_pow_static(fp_strict(fp_add(alpha, one)), (P_INT - 1) // 2)
     cand_b = tw.fq2_mul(b, x0)
     return jnp.where(is_neg1[..., None, None], cand_a, cand_b)
@@ -123,9 +125,9 @@ def fq2_sgn0(a: jnp.ndarray) -> jnp.ndarray:
     c0 == 0.  Needs the canonical residue, hence a full reduction."""
     r0 = fl.fp_reduce_full(a[..., 0, :])
     r1 = fl.fp_reduce_full(a[..., 1, :])
-    sign0 = (r0[..., 0] & 1).astype(bool)
+    sign0 = (r0[..., 0] % 2) == 1
     zero0 = jnp.all(r0 == 0, axis=-1)
-    sign1 = (r1[..., 0] & 1).astype(bool)
+    sign1 = (r1[..., 0] % 2) == 1
     return sign0 | (zero0 & sign1)
 
 
@@ -139,7 +141,7 @@ def _gprime(x: jnp.ndarray) -> jnp.ndarray:
     x2 = tw.fq2_sqr(x)
     m = tw.fq2_mul_many(
         jnp.stack([x2, x], axis=-3),
-        jnp.stack([x, jnp.broadcast_to(jnp.asarray(ISO_A), x.shape).astype(jnp.uint32)], axis=-3),
+        jnp.stack([x, jnp.broadcast_to(jnp.asarray(ISO_A), x.shape).astype(fl.DTYPE)], axis=-3),
     )
     x3, ax = m[..., 0, :, :], m[..., 1, :, :]
     return fp_strict(fp_add(fp_add(x3, ax), jnp.broadcast_to(jnp.asarray(ISO_B), x.shape)))
@@ -148,7 +150,7 @@ def _gprime(x: jnp.ndarray) -> jnp.ndarray:
 @jax.jit
 def map_to_curve_sswu(u: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Simplified SWU onto E' (oracle map_to_curve_sswu, select-based)."""
-    z = jnp.broadcast_to(jnp.asarray(SSWU_Z), u.shape).astype(jnp.uint32)
+    z = jnp.broadcast_to(jnp.asarray(SSWU_Z), u.shape).astype(fl.DTYPE)
     u2 = tw.fq2_sqr(u)
     m1 = tw.fq2_mul_many(jnp.stack([u2, u2], axis=-3), jnp.stack([u2, z], axis=-3))
     u4, zu2 = m1[..., 0, :, :], m1[..., 1, :, :]
@@ -161,11 +163,11 @@ def map_to_curve_sswu(u: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     tv1_zero = tw.fq2_is_zero(tv1)
     # regular arm: x1 = (-B/A) * (1 + 1/tv1)
     tv1_inv = tw.fq2_inv(tv1)
-    one = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE), u.shape).astype(jnp.uint32)
-    nba = jnp.broadcast_to(jnp.asarray(NEG_B_OVER_A), u.shape).astype(jnp.uint32)
+    one = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE), u.shape).astype(fl.DTYPE)
+    nba = jnp.broadcast_to(jnp.asarray(NEG_B_OVER_A), u.shape).astype(fl.DTYPE)
     x1_reg = tw.fq2_mul(nba, fp_strict(fp_add(one, tv1_inv)))
     # exceptional arm: x1 = B / (Z*A)
-    x1_exc = jnp.broadcast_to(jnp.asarray(B_OVER_ZA), u.shape).astype(jnp.uint32)
+    x1_exc = jnp.broadcast_to(jnp.asarray(B_OVER_ZA), u.shape).astype(fl.DTYPE)
     x1 = jnp.where(tv1_zero[..., None, None], x1_exc, x1_reg)
     gx1 = _gprime(x1)
     square1 = fq2_is_square(gx1)
@@ -182,7 +184,7 @@ def map_to_curve_sswu(u: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
 
 def _eval_poly(coeffs: np.ndarray, x: jnp.ndarray) -> jnp.ndarray:
     """Horner with constant Fq2 coefficients (oracle _eval_poly)."""
-    acc = jnp.broadcast_to(jnp.asarray(coeffs[-1]), x.shape).astype(jnp.uint32)
+    acc = jnp.broadcast_to(jnp.asarray(coeffs[-1]), x.shape).astype(fl.DTYPE)
     for c in reversed(coeffs[:-1]):
         acc = fp_strict(fp_add(tw.fq2_mul(acc, x), jnp.broadcast_to(jnp.asarray(c), x.shape)))
     return acc
@@ -210,7 +212,7 @@ def map_to_curve_g2(u: jnp.ndarray) -> Point:
     """SSWU + isogeny -> jacobian point on E2 (z = 1)."""
     x, y = map_to_curve_sswu(u)
     xm, ym = iso_map(x, y)
-    z = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE), xm.shape).astype(jnp.uint32)
+    z = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE), xm.shape).astype(fl.DTYPE)
     return (xm, ym, z)
 
 

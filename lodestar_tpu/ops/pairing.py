@@ -40,7 +40,7 @@ from .limbs import fp_add, fp_strict, fp_sub
 from .points import FQ2_NS, Point
 
 # bits of |BLS_X| after the leading 1, MSB first (static: 63 entries, 5 set)
-_X_BITS = np.array([int(c) for c in bin(abs(BLS_X))[3:]], dtype=np.uint32)
+_X_BITS = np.array([int(c) for c in bin(abs(BLS_X))[3:]], dtype=fl.NP_DTYPE)
 
 # hard-part exponent, computed not transcribed
 _HARD_EXP = (P_INT**4 - P_INT**2 + 1) // R_INT
@@ -143,8 +143,8 @@ def miller_loop(xp, yp, xq, yq):
     coords of the (twist) G2 point.  Returns (..., 2, 3, 2, 26) Fq12.
     Oracle: crypto/bls/pairing.py miller_loop.
     """
-    f = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), xp.shape[:-1] + (2, 3, 2, fl.NLIMBS)).astype(jnp.uint32)
-    one = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE), xq.shape).astype(jnp.uint32)
+    f = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), xp.shape[:-1] + (2, 3, 2, fl.NLIMBS)).astype(fl.DTYPE)
+    one = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE), xq.shape).astype(fl.DTYPE)
     t = (xq, yq, one)
 
     def body(carry, bit):
@@ -178,7 +178,7 @@ def final_exponentiation(f):
         r = tw.fq12_select(bit.astype(bool), tw.fq12_mul(r, f2), r)
         return r, None
 
-    init = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), f2.shape).astype(jnp.uint32)
+    init = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), f2.shape).astype(fl.DTYPE)
     out, _ = lax.scan(body, init, bits)
     return out
 
@@ -198,13 +198,13 @@ def multi_miller_product(xp, yp, xq, yq, mask):
     mask: (N,) bool — True = include this pair.
     """
     f = miller_loop(xp, yp, xq, yq)  # (N, ..., 2, 3, 2, 26)
-    one = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), f.shape).astype(jnp.uint32)
+    one = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), f.shape).astype(fl.DTYPE)
     f = tw.fq12_select(mask, f, one)
     # pairwise product tree over axis 0
     while f.shape[0] > 1:
         n = f.shape[0]
         if n % 2:
-            pad = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), (1,) + f.shape[1:]).astype(jnp.uint32)
+            pad = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), (1,) + f.shape[1:]).astype(fl.DTYPE)
             f = jnp.concatenate([f, pad])
             n += 1
         half = n // 2

@@ -122,13 +122,13 @@ Point = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]
 
 def point_infinity(ns: FieldNS, batch_shape=()) -> Point:
     shape = batch_shape + ns.one_const.shape
-    one = jnp.broadcast_to(jnp.asarray(ns.one_const), shape).astype(jnp.uint32)
-    zero = jnp.zeros(shape, dtype=jnp.uint32)
+    one = jnp.broadcast_to(jnp.asarray(ns.one_const), shape).astype(fl.DTYPE)
+    zero = jnp.zeros(shape, dtype=fl.DTYPE)
     return (one, one, zero)
 
 
 def point_from_affine(x: jnp.ndarray, y: jnp.ndarray, ns: FieldNS) -> Point:
-    z = jnp.broadcast_to(jnp.asarray(ns.one_const), x.shape).astype(jnp.uint32)
+    z = jnp.broadcast_to(jnp.asarray(ns.one_const), x.shape).astype(fl.DTYPE)
     return (x, y, z)
 
 

@@ -1,7 +1,7 @@
 """Extension-tower kernels Fq2 / Fq6 / Fq12 over the limb representation.
 
 Tower construction matches the oracle (lodestar_tpu.crypto.bls.fields):
-    Fq2  = Fq[u]  / (u^2 + 1)          -> (..., 2, 26) uint32
+    Fq2  = Fq[u]  / (u^2 + 1)          -> (..., 2, 50) float32 digits
     Fq6  = Fq2[v] / (v^3 - xi), xi=1+u -> (..., 3, 2, 26)
     Fq12 = Fq6[w] / (w^2 - v)          -> (..., 2, 3, 2, 26)
 
@@ -48,6 +48,7 @@ XI = fq2_const(F.XI)
 FROB_C1_V = fq2_const(F.FROB_C1_V)
 FROB_C1_V2 = fq2_const(F.FROB_C1_V2)
 FROB_C1_W = fq2_const(F.FROB_C1_W)
+FROB_C1_V_PAIR = np.stack([FROB_C1_V, FROB_C1_V2])  # stable object (constant-stability rule, ops/limbs.py)
 
 FQ6_ZERO = np.stack([FQ2_ZERO] * 3)
 FQ6_ONE = np.stack([FQ2_ONE, FQ2_ZERO, FQ2_ZERO])
@@ -56,7 +57,7 @@ FQ12_ZERO = np.stack([FQ6_ZERO, FQ6_ZERO])
 
 
 def fq12_const(v: F.Fq12) -> np.ndarray:
-    out = np.zeros((2, 3, 2, fl.NLIMBS), dtype=np.uint32)
+    out = np.zeros((2, 3, 2, fl.NLIMBS), dtype=fl.NP_DTYPE)
     for i, c6 in enumerate((v.c0, v.c1)):
         for j, c2 in enumerate((c6.c0, c6.c1, c6.c2)):
             out[i, j] = fq2_const(c2)
@@ -241,7 +242,7 @@ def fq6_frobenius(a: jnp.ndarray) -> jnp.ndarray:
     c0 = fq2_conj(a[..., 0, :, :])
     scaled = fq2_mul_many(
         jnp.stack([fq2_conj(a[..., 1, :, :]), fq2_conj(a[..., 2, :, :])], axis=-3),
-        jnp.broadcast_to(jnp.asarray(np.stack([FROB_C1_V, FROB_C1_V2])), a.shape[:-3] + (2, 2, fl.NLIMBS)),
+        jnp.broadcast_to(jnp.asarray(FROB_C1_V_PAIR), a.shape[:-3] + (2, 2, fl.NLIMBS)),
     )
     return jnp.stack([c0, scaled[..., 0, :, :], scaled[..., 1, :, :]], axis=-3)
 

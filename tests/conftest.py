@@ -8,6 +8,12 @@ before jax is imported anywhere.
 import os
 import sys
 
+# NOTE: the JAX_PLATFORMS env var is NOT sufficient here — an accelerator
+# plugin installed via sitecustomize can force-register itself regardless
+# of the env (observed in this image: every "CPU" test silently ran on the
+# TPU backend, which also has the fusion miscompile the kernels guard
+# against).  The config API below is authoritative; keep the env vars as
+# best-effort hints only.
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -17,10 +23,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_ROOT)
 
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 # Persistent XLA compilation cache: the kernel graphs (Miller loop, final
 # exponentiation, subgroup ladders) take minutes to compile on a 1-core
 # host; caching them across pytest processes keeps the suite re-runnable.
-import jax  # noqa: E402
-
 jax.config.update("jax_compilation_cache_dir", os.path.join(_REPO_ROOT, ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

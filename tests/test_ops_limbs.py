@@ -27,11 +27,11 @@ def adversarial_ints():
     """Edge values for carry/fold paths."""
     vals = [0, 1, 2, P - 1, P - 2, P, P + 1, (1 << 381) - 1, (1 << 384) - 1]
     # all-0xffff digit patterns and single-high-digit patterns
-    vals.append((1 << 416) - 1)
-    vals.append(((1 << 416) - 1) - 0xFFFF)
+    vals.append((1 << fl.VALUE_BITS) - 1)
+    vals.append(((1 << fl.VALUE_BITS) - 1) - 0xFFFF)
     for k in (0, 12, 24, 25):
         vals.append(0xFFFF << (16 * k))
-    return [v % (1 << 416) for v in vals]
+    return [v % (1 << fl.VALUE_BITS) for v in vals]
 
 
 def to_dev(ints):
@@ -43,19 +43,21 @@ def check_batch(arr, expected_ints):
     assert arr.shape[-1] == fl.NLIMBS
     for row, exp in zip(arr.reshape(-1, fl.NLIMBS), expected_ints):
         got = fl.limbs_to_int(row)
-        assert got < (1 << 416), "strict invariant violated (value >= 2^416)"
-        assert np.all(row < (1 << 16)), "strict invariant violated (digit >= 2^16)"
+        # semi-strict representation: digits <= 2^8 (fixed point of the
+        # branch-free folding carries), value < ~1.004 * 2^VALUE_BITS
+        assert got < (1 << (fl.VALUE_BITS + 1)), "strict invariant violated (value)"
+        assert np.all(row <= (1 << fl.LIMB_BITS)), "strict invariant violated (loose digit)"
         assert got % P == exp % P, f"mod-p mismatch: got {hex(got)} want {hex(exp % P)}"
 
 
 class TestPacking:
     def test_roundtrip(self):
-        for v in rand_ints(20, 1 << 416) + adversarial_ints():
+        for v in rand_ints(20, 1 << fl.VALUE_BITS) + adversarial_ints():
             assert fl.limbs_to_int(fl.int_to_limbs(v)) == v
 
     def test_out_of_range(self):
         with pytest.raises(ValueError):
-            fl.int_to_limbs(1 << 416)
+            fl.int_to_limbs(1 << fl.VALUE_BITS)
         with pytest.raises(ValueError):
             fl.int_to_limbs(-1)
 
@@ -63,30 +65,30 @@ class TestPacking:
 class TestRing:
     def test_add_strict_chain(self):
         # chains of lazy adds then one fp_strict
-        a, b, c, d = (rand_ints(64, 1 << 416) for _ in range(4))
+        a, b, c, d = (rand_ints(64, 1 << fl.VALUE_BITS) for _ in range(4))
         out = fl.fp_strict(fl.fp_add(fl.fp_add(to_dev(a), to_dev(b)), fl.fp_add(to_dev(c), to_dev(d))))
         check_batch(out, [w + x + y + z for w, x, y, z in zip(a, b, c, d)])
 
     def test_sub(self):
-        a, b = rand_ints(64, 1 << 416), rand_ints(64, 1 << 416)
+        a, b = rand_ints(64, 1 << fl.VALUE_BITS), rand_ints(64, 1 << fl.VALUE_BITS)
         out = fl.fp_sub(to_dev(a), to_dev(b))
         check_batch(out, [(x - y) % P for x, y in zip(a, b)])
 
     def test_sub_loose_inputs(self):
         # minuend loose from a 4-add chain; subtrahend loose from one add
-        a, b, c, d = (rand_ints(32, 1 << 416) for _ in range(4))
+        a, b, c, d = (rand_ints(32, 1 << fl.VALUE_BITS) for _ in range(4))
         minuend = fl.fp_add(fl.fp_add(to_dev(a), to_dev(b)), to_dev(c))  # digits < 3*2^16 < 2^18
         subtrahend = fl.fp_add(to_dev(d), to_dev(a))  # digits < 2^17 < 2^20 bound
         out = fl.fp_sub(minuend, subtrahend)
         check_batch(out, [(x + y + z - (w + x)) % P for x, y, z, w in zip(a, b, c, d)])
 
     def test_neg(self):
-        a = rand_ints(32, 1 << 416) + adversarial_ints()
+        a = rand_ints(32, 1 << fl.VALUE_BITS) + adversarial_ints()
         out = fl.fp_neg(to_dev(a))
         check_batch(out, [(-x) % P for x in a])
 
     def test_mul_random(self):
-        a, b = rand_ints(128, 1 << 416), rand_ints(128, 1 << 416)
+        a, b = rand_ints(128, 1 << fl.VALUE_BITS), rand_ints(128, 1 << fl.VALUE_BITS)
         out = fl.fp_mul(to_dev(a), to_dev(b))
         check_batch(out, [x * y % P for x, y in zip(a, b)])
 
@@ -98,13 +100,13 @@ class TestRing:
         check_batch(out, [x * y % P for x, y in zip(a, b)])
 
     def test_mul_loose_flag(self):
-        a, b, c = rand_ints(16, 1 << 416), rand_ints(16, 1 << 416), rand_ints(16, 1 << 416)
+        a, b, c = rand_ints(16, 1 << fl.VALUE_BITS), rand_ints(16, 1 << fl.VALUE_BITS), rand_ints(16, 1 << fl.VALUE_BITS)
         loose = fl.fp_add(to_dev(a), to_dev(b))
         out = fl.fp_mul(loose, to_dev(c), a_strict=False)
         check_batch(out, [(x + y) * z % P for x, y, z in zip(a, b, c)])
 
     def test_mul_small(self):
-        a = rand_ints(32, 1 << 416) + adversarial_ints()
+        a = rand_ints(32, 1 << fl.VALUE_BITS) + adversarial_ints()
         for k in (0, 1, 2, 3, 8, 12, (1 << 14) - 1):
             out = fl.fp_mul_small(to_dev(a), k)
             check_batch(out, [x * k % P for x in a])
@@ -121,7 +123,7 @@ class TestRing:
 
 class TestReduceCompare:
     def test_reduce_full(self):
-        vals = rand_ints(64, 1 << 416) + adversarial_ints()
+        vals = rand_ints(64, 1 << fl.VALUE_BITS) + adversarial_ints()
         out = np.asarray(fl.fp_reduce_full(to_dev(vals)))
         for row, v in zip(out, vals):
             got = fl.limbs_to_int(row)
