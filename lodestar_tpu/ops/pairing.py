@@ -16,13 +16,19 @@ bls.Signature.verifyMultipleSignatures).  Differences from the oracle
   the round-2 compile-time killer; compute-both+select keeps the body a
   straight line of vector ops at ~1.6x the minimal flops, which the batch
   axis amortizes.
-- Final exponentiation: easy part structurally (conj * inv, frobenius), hard
-  part by square-and-multiply scan over the bits of the *computed* exponent
-  (p^4 - p^2 + 1) // r.  Batch verification calls it once per batch on the
-  product of Miller values (multi_pairing semantics of the oracle).
+- Final exponentiation: easy part structurally (conj * inv, frobenius);
+  hard part via the BLS12 x-addition-chain (round-3 speedup) — five
+  64-bit pow-by-x scans plus a handful of Fq12 muls instead of a
+  ~1270-bit square-and-multiply scan (~5x fewer sequential steps, the
+  dominant serial cost of a batched verify dispatch).  The chain computes
+  f^(3*lambda) where lambda = (p^4 - p^2 + 1)/r; for values in mu_r
+  (prime r, gcd(3, r) = 1) the cube changes nothing about the is-one
+  verdict, which is the only consumer.  Identity checked at import
+  against the computed exponent, and differentially against the oracle.
 
 All leading axes broadcast; miller_loop over a (N, ...) batch of pairs is
-one vectorized program.
+one vectorized program.  Fq12 values use the FLAT (..., 6, 2, 50) layout
+(see ops/tower.py — the nested layout miscompiled on the TPU backend).
 """
 
 from __future__ import annotations
@@ -45,14 +51,26 @@ _X_BITS = np.array([int(c) for c in bin(abs(BLS_X))[3:]], dtype=fl.NP_DTYPE)
 # hard-part exponent, computed not transcribed
 _HARD_EXP = (P_INT**4 - P_INT**2 + 1) // R_INT
 
+# The x-chain computes the hard part to the exponent
+#   (x-1)^2 * (x+p) * (x^2 + p^2 - 1) + 3
+# which equals 3*lambda' where lambda' = hard exponent + multiple-of-r
+# correction.  Verify the polynomial identity numerically at import (it
+# must hold modulo nothing — it is exact for the BLS12 parameterization):
+_CHAIN_EXP = (BLS_X - 1) ** 2 * (BLS_X + P_INT) * (BLS_X**2 + P_INT**2 - 1) + 3
+assert _CHAIN_EXP % R_INT == (3 * _HARD_EXP) % R_INT, "x-chain identity broken"
+# For elements of the cyclotomic subgroup the exponent acts modulo the
+# subgroup order Phi_12(p) = p^4 - p^2 + 1; check the full congruence:
+assert _CHAIN_EXP % (P_INT**4 - P_INT**2 + 1) == (3 * _HARD_EXP) % (
+    P_INT**4 - P_INT**2 + 1
+), "x-chain identity broken mod Phi12(p)"
+
 
 def _line_to_fq12(c0, c1, c2):
     """Assemble the sparse line value  (c0 + c1 v) + (c2 v) w  as a full
-    Fq12 array (c0, c1, c2: (..., 2, 26) Fq2).  Mirrors oracle _line()."""
+    FLAT Fq12 array (c0, c1, c2: (..., 2, 50) Fq2).  Mirrors oracle
+    _line(): components [c0, c1, 0, 0, c2, 0]."""
     zero = jnp.zeros_like(c0)
-    six0 = jnp.stack([c0, c1, zero], axis=-3)
-    six1 = jnp.stack([zero, c2, zero], axis=-3)
-    return jnp.stack([six0, six1], axis=-4)
+    return jnp.stack([c0, c1, zero, zero, c2, zero], axis=-3)
 
 
 def _dbl_step(t: Point, xp, yp):
@@ -80,7 +98,6 @@ def _dbl_step(t: Point, xp, yp):
     c1 = jnp.stack([fl.fp_neg(c1[..., 0, :]), fl.fp_neg(c1[..., 1, :])], axis=-2)
     yz3_2 = fp_strict(fp_add(yz3, yz3))
     c2 = tw.fq2_scale_fq(yz3_2, yp)
-    # T = 2T, sharing nothing for now (correctness first)
     from .points import point_double
 
     t2 = point_double(t, FQ2_NS)
@@ -131,7 +148,7 @@ def _add_step(t: Point, xq, yq, xp, yp):
     )
     rvx, yj = m8[..., 0, :, :], m8[..., 1, :, :]
     y3 = fp_sub(rvx, fp_strict(fp_add(yj, yj)))
-    z3 = fp_strict(fp_add(zh_m, zh_m))  # 2 Z H ... = (Z+H)^2 - ZZ - HH
+    z3 = fp_strict(fp_add(zh_m, zh_m))  # 2 Z H
     return (x3, y3, z3), line
 
 
@@ -139,11 +156,13 @@ def _add_step(t: Point, xq, yq, xp, yp):
 def miller_loop(xp, yp, xq, yq):
     """f_{|z|, Q}(P) conjugated for the negative BLS parameter.
 
-    xp, yp: (..., 26) Fq affine G1 coords; xq, yq: (..., 2, 26) Fq2 affine
-    coords of the (twist) G2 point.  Returns (..., 2, 3, 2, 26) Fq12.
+    xp, yp: (..., 50) Fq affine G1 coords; xq, yq: (..., 2, 50) Fq2 affine
+    coords of the (twist) G2 point.  Returns (..., 6, 2, 50) flat Fq12.
     Oracle: crypto/bls/pairing.py miller_loop.
     """
-    f = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), xp.shape[:-1] + (2, 3, 2, fl.NLIMBS)).astype(fl.DTYPE)
+    f = jnp.broadcast_to(
+        jnp.asarray(tw.FQ12_ONE), xp.shape[:-1] + (6, 2, fl.NLIMBS)
+    ).astype(fl.DTYPE)
     one = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE), xq.shape).astype(fl.DTYPE)
     t = (xq, yq, one)
 
@@ -155,7 +174,7 @@ def miller_loop(xp, yp, xq, yq):
         # branch-free conditional add: compute, then select by the bit
         t2, line2 = _add_step(t, xq, yq, xp, yp)
         f2 = tw.fq12_mul(f, line2)
-        take = bit.astype(bool)
+        take = bit != 0
         f = tw.fq12_select(take, f2, f)
         t = tuple(jnp.where(take[..., None, None], a, b) for a, b in zip(t2, t))
         return (f, t), None
@@ -164,28 +183,57 @@ def miller_loop(xp, yp, xq, yq):
     return tw.fq12_conj(f)
 
 
-@jax.jit
-def final_exponentiation(f):
-    """f^((p^12-1)/r).  Easy part structural; hard part is a scan over the
-    computed exponent bits.  Oracle: pairing.final_exponentiation."""
-    f1 = tw.fq12_mul(tw.fq12_conj(f), tw.fq12_inv(f))  # f^(p^6 - 1)
-    f2 = tw.fq12_mul(tw.fq12_frobenius(tw.fq12_frobenius(f1)), f1)  # ^(p^2 + 1)
-
-    bits = jnp.asarray(fl._exp_bits(_HARD_EXP))
+def _pow_x_abs(f):
+    """f^|BLS_X| by square-and-multiply scan over the 64 bits (MSB first).
+    f must be in the cyclotomic subgroup (callers only use it there)."""
+    bits = jnp.asarray(_X_BITS)
 
     def body(r, bit):
         r = tw.fq12_sqr(r)
-        r = tw.fq12_select(bit.astype(bool), tw.fq12_mul(r, f2), r)
+        r = tw.fq12_select(bit != 0, tw.fq12_mul(r, f), r)
         return r, None
 
-    init = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), f2.shape).astype(fl.DTYPE)
-    out, _ = lax.scan(body, init, bits)
+    # leading bit of |x| is 1: start from f (skips one square+mul)
+    out, _ = lax.scan(body, f, bits)
     return out
+
+
+def _pow_x(f):
+    """f^BLS_X for the (negative) BLS parameter: conj inverts in the
+    cyclotomic subgroup."""
+    out = _pow_x_abs(f)
+    return tw.fq12_conj(out) if BLS_X < 0 else out
+
+
+@jax.jit
+def final_exponentiation(f):
+    """f^(3 * (p^12-1)/r) — the cube is harmless for mu_r membership
+    verdicts (see module docstring).  Easy part structural; hard part by
+    the BLS12 x-chain:
+        m  = f^((p^6-1)(p^2+1))
+        y0 = m^(x-1);  y1 = y0^(x-1)            # m^((x-1)^2)
+        y2 = y1^x * y1^p                        # ^(x+p)
+        y3 = y2^(x^2) * y2^(p^2) * y2^-1        # ^(x^2 + p^2 - 1)
+        out = y3 * m^2 * m                      # * m^3
+    Oracle check: pairing.final_exponentiation cubed."""
+    f1 = tw.fq12_mul(tw.fq12_conj(f), tw.fq12_inv(f))  # f^(p^6 - 1)
+    m = tw.fq12_mul(tw.fq12_frobenius(tw.fq12_frobenius(f1)), f1)  # ^(p^2 + 1)
+
+    y0 = tw.fq12_mul(_pow_x(m), tw.fq12_conj(m))    # m^(x-1)
+    y1 = tw.fq12_mul(_pow_x(y0), tw.fq12_conj(y0))  # m^((x-1)^2)
+    y2 = tw.fq12_mul(_pow_x(y1), tw.fq12_frobenius(y1))  # ^(x+p)
+    y3 = tw.fq12_mul(
+        tw.fq12_mul(_pow_x(_pow_x(y2)), tw.fq12_frobenius(tw.fq12_frobenius(y2))),
+        tw.fq12_conj(y2),
+    )  # ^(x^2 + p^2 - 1)
+    m2 = tw.fq12_sqr(m)
+    return tw.fq12_mul(y3, tw.fq12_mul(m2, m))
 
 
 @jax.jit
 def pairing(xp, yp, xq, yq):
-    """e(P, Q) for affine inputs (no infinity handling — callers mask)."""
+    """e(P, Q)^3 for affine inputs (no infinity handling — callers mask).
+    The cube matches final_exponentiation; is-one verdicts are unaffected."""
     return final_exponentiation(miller_loop(xp, yp, xq, yq))
 
 
@@ -197,14 +245,16 @@ def multi_miller_product(xp, yp, xq, yq, mask):
 
     mask: (N,) bool — True = include this pair.
     """
-    f = miller_loop(xp, yp, xq, yq)  # (N, ..., 2, 3, 2, 26)
+    f = miller_loop(xp, yp, xq, yq)  # (N, ..., 6, 2, 50)
     one = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), f.shape).astype(fl.DTYPE)
     f = tw.fq12_select(mask, f, one)
     # pairwise product tree over axis 0
     while f.shape[0] > 1:
         n = f.shape[0]
         if n % 2:
-            pad = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), (1,) + f.shape[1:]).astype(fl.DTYPE)
+            pad = jnp.broadcast_to(
+                jnp.asarray(tw.FQ12_ONE), (1,) + f.shape[1:]
+            ).astype(fl.DTYPE)
             f = jnp.concatenate([f, pad])
             n += 1
         half = n // 2

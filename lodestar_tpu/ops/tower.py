@@ -2,19 +2,34 @@
 
 Tower construction matches the oracle (lodestar_tpu.crypto.bls.fields):
     Fq2  = Fq[u]  / (u^2 + 1)          -> (..., 2, 50) float32 digits
-    Fq6  = Fq2[v] / (v^3 - xi), xi=1+u -> (..., 3, 2, 26)
-    Fq12 = Fq6[w] / (w^2 - v)          -> (..., 2, 3, 2, 26)
+    Fq6  = Fq2[v] / (v^3 - xi), xi=1+u -> (..., 3, 2, 50)
+    Fq12 = Fq6[w] / (w^2 - v)          -> (..., 6, 2, 50)  FLAT components
+                                          [c00, c01, c02, c10, c11, c12]
 
-The design rule that makes this TPU-shaped: every multi-multiplication
-(Karatsuba/Toom branches of a tower product) is *stacked* into a single
-broadcasted ``fp_mul`` call instead of separate calls — one Fq12 multiply
-issues one 54-lane limb multiply rather than 54 small ones.  This keeps the
-XLA graph small (a Miller-loop scan body stays compilable) and the TPU
-vector units wide.  It replaces the reference's blst assembly tower
-(SURVEY.md §2.9) rather than translating it.
+Two design rules make this TPU-shaped:
+
+1. STACKED MULTIPLIES: every multi-multiplication (Karatsuba/Toom branches
+   of a tower product) is collected into a single broadcasted ``fp_mul``
+   over one flat lane axis — one Fq12 multiply issues one 54-lane limb
+   multiply rather than 54 small ones.  This keeps the XLA graph small and
+   the TPU vector units wide.  It replaces the reference's blst assembly
+   tower (SURVEY.md §2.9) rather than translating it.
+
+2. FLAT LANE PLUMBING (round-3): Fq12 values are rank-(n+3) flat
+   (..., 6, 2, 50) arrays, and every tower op builds its lane batches with
+   ONE jnp.stack over component slices — never stack-of-stacks followed by
+   orthogonal re-slicing and reshape.  The earlier nested layout
+   (..., 2, 3, 2, 50) triggered a reproducible TPU-backend miscompile:
+   inside large fused programs, lanes derived from re-sliced nested stacks
+   silently computed wrong digits (the CPU backend was always correct; the
+   failure was deterministic, survived every optimization-disabling flag,
+   and moved around when outputs were added to the program).  Flat
+   single-level stacking is the empirically safe pattern — and rank <= 5
+   tensors lower to better TPU tilings anyway.
 
 Add/sub/neg/select need no tower-specific code: the limb ops broadcast over
-the component axes, so ``fp_add`` on an Fq12 array adds all 12 coordinates.
+the component axes, so ``fp_add`` on a flat Fq12 array adds all 12
+coordinates.
 
 Frobenius coefficients are taken from the oracle's *computed* constants
 (fields.FROB_C1_V etc.), converted to limbs — never transcribed.
@@ -37,7 +52,7 @@ from .limbs import fp_add, fp_mul, fp_neg, fp_select, fp_strict, fp_sub
 
 
 def fq2_const(v: F.Fq2) -> np.ndarray:
-    """Oracle Fq2 -> (2, 26) numpy limb constant."""
+    """Oracle Fq2 -> (2, 50) numpy limb constant."""
     return np.stack([fl.int_to_limbs(v.c0), fl.int_to_limbs(v.c1)])
 
 
@@ -48,19 +63,20 @@ XI = fq2_const(F.XI)
 FROB_C1_V = fq2_const(F.FROB_C1_V)
 FROB_C1_V2 = fq2_const(F.FROB_C1_V2)
 FROB_C1_W = fq2_const(F.FROB_C1_W)
-FROB_C1_V_PAIR = np.stack([FROB_C1_V, FROB_C1_V2])  # stable object (constant-stability rule, ops/limbs.py)
+# stable combined object (constant-stability rule, ops/limbs.py RED_ROWS)
+FROB_C1_V_PAIR = np.stack([FROB_C1_V, FROB_C1_V2])
 
 FQ6_ZERO = np.stack([FQ2_ZERO] * 3)
 FQ6_ONE = np.stack([FQ2_ONE, FQ2_ZERO, FQ2_ZERO])
-FQ12_ONE = np.stack([FQ6_ONE, FQ6_ZERO])
-FQ12_ZERO = np.stack([FQ6_ZERO, FQ6_ZERO])
+FQ12_ONE = np.concatenate([FQ6_ONE, FQ6_ZERO])  # (6, 2, 50) flat
+FQ12_ZERO = np.concatenate([FQ6_ZERO, FQ6_ZERO])
 
 
 def fq12_const(v: F.Fq12) -> np.ndarray:
-    out = np.zeros((2, 3, 2, fl.NLIMBS), dtype=fl.NP_DTYPE)
+    out = np.zeros((6, 2, fl.NLIMBS), dtype=fl.NP_DTYPE)
     for i, c6 in enumerate((v.c0, v.c1)):
         for j, c2 in enumerate((c6.c0, c6.c1, c6.c2)):
-            out[i, j] = fq2_const(c2)
+            out[i * 3 + j] = fq2_const(c2)
     return out
 
 
@@ -85,7 +101,10 @@ def fq6_to_oracle(arr) -> F.Fq6:
 
 def fq12_to_oracle(arr) -> F.Fq12:
     arr = np.asarray(arr)
-    return F.Fq12(fq6_to_oracle(arr[0]), fq6_to_oracle(arr[1]))
+    return F.Fq12(
+        F.Fq6(*[fq2_to_oracle(arr[j]) for j in range(3)]),
+        F.Fq6(*[fq2_to_oracle(arr[3 + j]) for j in range(3)]),
+    )
 
 
 def fq12_from_oracle(v: F.Fq12) -> np.ndarray:
@@ -97,17 +116,16 @@ def fq12_from_oracle(v: F.Fq12) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
 def fq2_mul_many(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """K independent Fq2 products in one limb multiply.
 
-    a, b: (..., K, 2, 26) strict -> (..., K, 2, 26) strict.
+    a, b: (..., K, 2, 50) strict -> (..., K, 2, 50) strict.
     Karatsuba per pair: t0=a0b0, t1=a1b1, t2=(a0+a1)(b0+b1);
     result = (t0 - t1) + (t2 - t0 - t1) u.
     """
     a0, a1 = a[..., 0, :], a[..., 1, :]
     b0, b1 = b[..., 0, :], b[..., 1, :]
-    lhs = jnp.stack([a0, a1, fp_strict(fp_add(a0, a1))], axis=-2)  # (..., K, 3, 26)
+    lhs = jnp.stack([a0, a1, fp_strict(fp_add(a0, a1))], axis=-2)  # (..., K, 3, 50)
     rhs = jnp.stack([b0, b1, fp_strict(fp_add(b0, b1))], axis=-2)
     t = fp_mul(lhs, rhs)
     t0, t1, t2 = t[..., 0, :], t[..., 1, :], t[..., 2, :]
@@ -117,7 +135,7 @@ def fq2_mul_many(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def fq2_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Single Fq2 product (a, b: (..., 2, 26))."""
+    """Single Fq2 product (a, b: (..., 2, 50))."""
     return fq2_mul_many(a[..., None, :, :], b[..., None, :, :])[..., 0, :, :]
 
 
@@ -144,7 +162,7 @@ def fq2_mul_by_xi(a: jnp.ndarray) -> jnp.ndarray:
 
 
 def fq2_scale_fq(a: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
-    """Multiply both Fq2 components by an Fq element s (..., 26)."""
+    """Multiply both Fq2 components by an Fq element s (..., 50)."""
     return fp_mul(a, s[..., None, :])
 
 
@@ -170,50 +188,52 @@ def fq2_is_zero(a: jnp.ndarray) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
-# Fq6
+# Fq6 — a value is (..., 3, 2, 50); internals pass component LISTS so all
+# stacking stays single-level (flat-lane rule)
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
-def fq6_mul_many(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """K independent Fq6 products: (..., K, 3, 2, 26) -> same shape.
-
-    Toom-style interpolation (same scheme as the oracle Fq6.__mul__):
-    6 Fq2 products per Fq6 product, all stacked into one fq2_mul_many.
-    """
-    a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
-    b0, b1, b2 = b[..., 0, :, :], b[..., 1, :, :], b[..., 2, :, :]
+def _fq6_mul_lanes(A, B):
+    """Toom lanes for one Fq6 product from component lists A, B (3 Fq2
+    each): the 6 lane pairs [a0b0, a1b1, a2b2, (a1+a2)(b1+b2),
+    (a0+a1)(b0+b1), (a0+a2)(b0+b2)] (same scheme as oracle Fq6.__mul__)."""
     s = fp_strict
-    lhs = jnp.stack(
-        [a0, a1, a2, s(fp_add(a1, a2)), s(fp_add(a0, a1)), s(fp_add(a0, a2))],
-        axis=-3,
-    )  # (..., K, 6, 2, 26)
-    rhs = jnp.stack(
-        [b0, b1, b2, s(fp_add(b1, b2)), s(fp_add(b0, b1)), s(fp_add(b0, b2))],
-        axis=-3,
-    )
-    kshape = lhs.shape
-    flat = fq2_mul_many(lhs.reshape(kshape[:-4] + (-1, 2, fl.NLIMBS)), rhs.reshape(kshape[:-4] + (-1, 2, fl.NLIMBS)))
-    t = flat.reshape(kshape)
-    t0, t1, t2 = t[..., 0, :, :], t[..., 1, :, :], t[..., 2, :, :]
-    t3, t4, t5 = t[..., 3, :, :], t[..., 4, :, :], t[..., 5, :, :]
-    c0 = fp_strict(fp_add(t0, fq2_mul_by_xi(fp_sub(t3, fp_add(t1, t2)))))
-    c1 = fp_strict(fp_add(fp_sub(t4, fp_add(t0, t1)), fq2_mul_by_xi(t2)))
-    c2 = fp_strict(fp_add(fp_sub(t5, fp_add(t0, t2)), t1))
-    return jnp.stack([c0, c1, c2], axis=-3)
+    ls = [A[0], A[1], A[2], s(fp_add(A[1], A[2])), s(fp_add(A[0], A[1])), s(fp_add(A[0], A[2]))]
+    rs = [B[0], B[1], B[2], s(fp_add(B[1], B[2])), s(fp_add(B[0], B[1])), s(fp_add(B[0], B[2]))]
+    return ls, rs
 
 
+def _fq6_recombine(t):
+    """Interpolate one Fq6 product from its 6 Fq2 lane products."""
+    t0, t1, t2, t3, t4, t5 = t
+    s = fp_strict
+    c0 = s(fp_add(t0, fq2_mul_by_xi(fp_sub(t3, fp_add(t1, t2)))))
+    c1 = s(fp_add(fp_sub(t4, fp_add(t0, t1)), fq2_mul_by_xi(t2)))
+    c2 = s(fp_add(fp_sub(t5, fp_add(t0, t2)), t1))
+    return [c0, c1, c2]
+
+
+@jax.jit
 def fq6_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return fq6_mul_many(a[..., None, :, :, :], b[..., None, :, :, :])[..., 0, :, :, :]
+    """Single Fq6 product: 6 Fq2 lanes in one flat fq2_mul_many."""
+    A = [a[..., j, :, :] for j in range(3)]
+    B = [b[..., j, :, :] for j in range(3)]
+    ls, rs = _fq6_mul_lanes(A, B)
+    q = fq2_mul_many(jnp.stack(ls, axis=-3), jnp.stack(rs, axis=-3))
+    return jnp.stack(_fq6_recombine([q[..., i, :, :] for i in range(6)]), axis=-3)
+
+
+def fq6_mul_by_v_comps(A):
+    """v * (c0, c1, c2) = (xi*c2, c0, c1) on a component list."""
+    return [fq2_mul_by_xi(A[2]), A[0], A[1]]
 
 
 def fq6_mul_by_v(a: jnp.ndarray) -> jnp.ndarray:
-    """v * (c0, c1, c2) = (xi*c2, c0, c1)."""
-    return jnp.stack([fq2_mul_by_xi(a[..., 2, :, :]), a[..., 0, :, :], a[..., 1, :, :]], axis=-3)
+    return jnp.stack(fq6_mul_by_v_comps([a[..., j, :, :] for j in range(3)]), axis=-3)
 
 
 def fq6_scale_fq2(a: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
-    """Multiply all three Fq2 components by s (..., 2, 26): 3 stacked Fq2 muls."""
+    """Multiply all three Fq2 components by s (..., 2, 50): 3 stacked Fq2 muls."""
     ss = jnp.broadcast_to(s[..., None, :, :], a.shape)
     return fq2_mul_many(a, ss)
 
@@ -248,71 +268,101 @@ def fq6_frobenius(a: jnp.ndarray) -> jnp.ndarray:
 
 
 # ---------------------------------------------------------------------------
-# Fq12
+# Fq12 — FLAT (..., 6, 2, 50), order [c00, c01, c02, c10, c11, c12]
 # ---------------------------------------------------------------------------
+
+
+def _fq12_comps(a):
+    return [a[..., i, :, :] for i in range(6)]
 
 
 @jax.jit
 def fq12_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Karatsuba over Fq6: 3 Fq6 products = 18 Fq2 products, one limb mul."""
-    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
-    b0, b1 = b[..., 0, :, :, :], b[..., 1, :, :, :]
-    lhs = jnp.stack([a0, a1, fp_strict(fp_add(a0, a1))], axis=-4)
-    rhs = jnp.stack([b0, b1, fp_strict(fp_add(b0, b1))], axis=-4)
-    t = fq6_mul_many(lhs, rhs)
-    t0, t1, t3 = t[..., 0, :, :, :], t[..., 1, :, :, :], t[..., 2, :, :, :]
-    c0 = fp_strict(fp_add(t0, fq6_mul_by_v(t1)))
-    c1 = fp_sub(t3, fp_add(t0, t1))
-    return jnp.stack([c0, c1], axis=-4)
+    """Karatsuba over Fq6: 3 Fq6 products = 18 Fq2 lanes, one limb multiply,
+    one flat stack."""
+    A = _fq12_comps(a)
+    B = _fq12_comps(b)
+    s = fp_strict
+    SA = [s(fp_add(A[j], A[3 + j])) for j in range(3)]  # comps of a0 + a1
+    SB = [s(fp_add(B[j], B[3 + j])) for j in range(3)]
+    Ls, Rs = [], []
+    for U, V in ((A[0:3], B[0:3]), (A[3:6], B[3:6]), (SA, SB)):
+        l6, r6 = _fq6_mul_lanes(U, V)
+        Ls += l6
+        Rs += r6
+    q = fq2_mul_many(jnp.stack(Ls, axis=-3), jnp.stack(Rs, axis=-3))  # (..., 18, 2, 50)
+    qs = [q[..., i, :, :] for i in range(18)]
+    T0 = _fq6_recombine(qs[0:6])    # a0*b0
+    T1 = _fq6_recombine(qs[6:12])   # a1*b1
+    T3 = _fq6_recombine(qs[12:18])  # (a0+a1)(b0+b1)
+    vT1 = fq6_mul_by_v_comps(T1)
+    C0 = [s(fp_add(T0[j], vT1[j])) for j in range(3)]
+    C1 = [fp_sub(T3[j], fp_add(T0[j], T1[j])) for j in range(3)]
+    return jnp.stack(C0 + C1, axis=-3)
 
 
 @jax.jit
 def fq12_sqr(a: jnp.ndarray) -> jnp.ndarray:
-    """(a0 + a1 w)^2 = (a0^2 + v a1^2) + 2 a0 a1 w, via Karatsuba:
-    m = a0*a1; s = (a0+a1)(a0 + v*a1); c0 = s - m - v*m; c1 = 2m."""
-    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
-    lhs = jnp.stack([a0, fp_strict(fp_add(a0, a1))], axis=-4)
-    rhs = jnp.stack([a1, fp_strict(fp_add(a0, fq6_mul_by_v(a1)))], axis=-4)
-    t = fq6_mul_many(lhs, rhs)
-    m, s = t[..., 0, :, :, :], t[..., 1, :, :, :]
-    c0 = fp_sub(s, fp_add(m, fq6_mul_by_v(m)))
-    c1 = fp_strict(fp_add(m, m))
-    return jnp.stack([c0, c1], axis=-4)
+    """(a0 + a1 w)^2 via Karatsuba: m = a0*a1; t = (a0+a1)(a0 + v*a1);
+    c0 = t - m - v*m; c1 = 2m.  12 Fq2 lanes in one flat stack."""
+    A = _fq12_comps(a)
+    s = fp_strict
+    a0c, a1c = A[0:3], A[3:6]
+    sa = [s(fp_add(a0c[j], a1c[j])) for j in range(3)]
+    va1 = fq6_mul_by_v_comps(a1c)
+    a0va1 = [s(fp_add(a0c[j], va1[j])) for j in range(3)]
+    Ls, Rs = [], []
+    for U, V in ((a0c, a1c), (sa, a0va1)):
+        l6, r6 = _fq6_mul_lanes(U, V)
+        Ls += l6
+        Rs += r6
+    q = fq2_mul_many(jnp.stack(Ls, axis=-3), jnp.stack(Rs, axis=-3))  # (..., 12, 2, 50)
+    qs = [q[..., i, :, :] for i in range(12)]
+    M = _fq6_recombine(qs[0:6])   # a0*a1
+    T = _fq6_recombine(qs[6:12])  # (a0+a1)(a0 + v a1)
+    vM = fq6_mul_by_v_comps(M)
+    C0 = [fp_sub(T[j], fp_add(M[j], vM[j])) for j in range(3)]
+    C1 = [s(fp_add(M[j], M[j])) for j in range(3)]
+    return jnp.stack(C0 + C1, axis=-3)
 
 
 def fq12_conj(a: jnp.ndarray) -> jnp.ndarray:
     """x -> x^(p^6); on the cyclotomic subgroup this is x^-1."""
-    return jnp.stack([a[..., 0, :, :, :], fp_neg(a[..., 1, :, :, :])], axis=-4)
+    A = _fq12_comps(a)
+    return jnp.stack(A[0:3] + [fp_neg(c) for c in A[3:6]], axis=-3)
 
 
 @jax.jit
 def fq12_frobenius(a: jnp.ndarray) -> jnp.ndarray:
-    c0 = fq6_frobenius(a[..., 0, :, :, :])
-    c1f = fq6_frobenius(a[..., 1, :, :, :])
+    A = _fq12_comps(a)
+    c0f = fq6_frobenius(jnp.stack(A[0:3], axis=-3))
+    c1f = fq6_frobenius(jnp.stack(A[3:6], axis=-3))
     w = jnp.broadcast_to(jnp.asarray(FROB_C1_W), c1f.shape[:-3] + (3, 2, fl.NLIMBS))
     c1 = fq2_mul_many(c1f, w)
-    return jnp.stack([c0, c1], axis=-4)
+    return jnp.concatenate([c0f, c1], axis=-3)
 
 
 @jax.jit
 def fq12_inv(a: jnp.ndarray) -> jnp.ndarray:
-    a0, a1 = a[..., 0, :, :, :], a[..., 1, :, :, :]
-    t = fq6_mul_many(jnp.stack([a0, a1], axis=-4), jnp.stack([a0, a1], axis=-4))
-    denom = fp_sub(t[..., 0, :, :, :], fq6_mul_by_v(t[..., 1, :, :, :]))
+    A = _fq12_comps(a)
+    a0 = jnp.stack(A[0:3], axis=-3)
+    a1 = jnp.stack(A[3:6], axis=-3)
+    t0 = fq6_mul(a0, a0)
+    t1 = fq6_mul(a1, a1)
+    denom = fp_sub(t0, fq6_mul_by_v(t1))
     dinv = fq6_inv(denom)
-    out = fq6_mul_many(
-        jnp.stack([a0, a1], axis=-4),
-        jnp.stack([dinv, dinv], axis=-4),
-    )
-    return jnp.stack([out[..., 0, :, :, :], fp_neg(out[..., 1, :, :, :])], axis=-4)
+    out0 = fq6_mul(a0, dinv)
+    out1 = fq6_mul(a1, dinv)
+    neg1 = jnp.stack([fp_neg(out1[..., j, :, :]) for j in range(3)], axis=-3)
+    return jnp.concatenate([out0, neg1], axis=-3)
 
 
 def fq12_select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """where(cond, a, b) with cond shaped (...,) broadcast over (2,3,2,26)."""
-    return jnp.where(cond[..., None, None, None, None], a, b)
+    """where(cond, a, b) with cond shaped (...,) broadcast over (6, 2, 50)."""
+    return jnp.where(cond[..., None, None, None], a, b)
 
 
 @jax.jit
 def fq12_is_one(a: jnp.ndarray) -> jnp.ndarray:
     one = jnp.asarray(FQ12_ONE)
-    return jnp.all(fl.fp_eq(a, jnp.broadcast_to(one, a.shape)), axis=(-3, -2, -1))
+    return jnp.all(fl.fp_eq(a, jnp.broadcast_to(one, a.shape)), axis=(-2, -1))
