@@ -18,8 +18,18 @@ caveat).  vs_baseline = device rate / measured CPU rate.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
+
+# Persistent XLA compilation cache: the batched-verify program costs
+# minutes of TPU compile cold; the repo-local cache (pre-warmed during the
+# build round, gitignored) brings a driver re-run down to seconds.
+_REPO = os.path.dirname(os.path.abspath(__file__))
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", os.path.join(_REPO, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 BATCH = 128
 
@@ -48,8 +58,12 @@ def bench_device(args, repeats: int = 3):
     return BATCH / dt, dt
 
 
-def bench_cpu_oracle(n: int = 8):
-    """Oracle (pure python bigint) batch verify throughput per set."""
+def bench_cpu_oracle(n: int = 2):
+    """Oracle (pure python bigint) batch verify throughput per set.
+
+    n=2 keeps the baseline measurement to a couple of bigint pairings
+    (~seconds) — the per-set rate extrapolates linearly and the driver's
+    wall-clock budget belongs to the device measurement."""
     from lodestar_tpu.crypto.bls.api import (
         interop_secret_key,
         verify_multiple_signatures,

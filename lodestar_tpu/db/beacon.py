@@ -21,6 +21,47 @@ from .repository import Repository
 from .schema import Bucket, decode_uint_key, encode_key, uint_key
 
 
+_FORK_ORDER = ("phase0", "altair", "bellatrix")
+
+
+def _fork_tagged_block_codec(preset: Preset):
+    """Fork-aware SignedBeaconBlock codec: a 1-byte fork tag prefixes the
+    SSZ bytes so each fork's container shape round-trips (the reference
+    keys its serializers off the fork digest in the same spirit;
+    db/repositories/block.ts getSignedBlockTypeFromBytes)."""
+    from ..state_transition.upgrade import block_fork_name
+
+    all_t = get_types(preset)
+
+    def enc(signed_block) -> bytes:
+        fork = block_fork_name(signed_block.message).value
+        t = getattr(all_t, fork)
+        return bytes([_FORK_ORDER.index(fork)]) + t.SignedBeaconBlock.serialize(signed_block)
+
+    def dec(b: bytes):
+        t = getattr(all_t, _FORK_ORDER[b[0]])
+        return t.SignedBeaconBlock.deserialize(b[1:])
+
+    return enc, dec
+
+
+def _fork_tagged_state_codec(preset: Preset):
+    from ..state_transition.upgrade import state_fork_name
+
+    all_t = get_types(preset)
+
+    def enc(state) -> bytes:
+        fork = state_fork_name(state).value
+        t = getattr(all_t, fork)
+        return bytes([_FORK_ORDER.index(fork)]) + t.BeaconState.serialize(state)
+
+    def dec(b: bytes):
+        t = getattr(all_t, _FORK_ORDER[b[0]])
+        return t.BeaconState.deserialize(b[1:])
+
+    return enc, dec
+
+
 class BeaconDb:
     def __init__(self, preset: Preset, db: Optional[IDatabaseController] = None):
         self.db = db or MemoryDbController()
@@ -28,10 +69,10 @@ class BeaconDb:
         self.t = t
         ser = lambda typ: (typ.serialize, typ.deserialize)  # noqa: E731
 
-        enc_b, dec_b = ser(t.SignedBeaconBlock)
+        enc_b, dec_b = _fork_tagged_block_codec(preset)
         self.block: Repository = Repository(self.db, Bucket.block, enc_b, dec_b)
         self.block_archive: Repository = Repository(self.db, Bucket.block_archive, enc_b, dec_b)
-        enc_s, dec_s = ser(t.BeaconState)
+        enc_s, dec_s = _fork_tagged_state_codec(preset)
         self.state: Repository = Repository(self.db, Bucket.state, enc_s, dec_s)
         self.state_archive: Repository = Repository(self.db, Bucket.state_archive, enc_s, dec_s)
         enc_e, dec_e = ser(t.Eth1Data)

@@ -49,6 +49,7 @@ class DevChain:
         bls_pool: BlsBatchPool,
         genesis_time: int = 0,
         metrics=None,
+        db=None,
     ):
         self.p = preset
         self.cfg = cfg
@@ -60,7 +61,7 @@ class DevChain:
         self.clock = LocalClock(
             genesis_time or 1, cfg.SECONDS_PER_SLOT, preset.SLOTS_PER_EPOCH
         )
-        self.chain = BeaconChain(preset, cfg, genesis, bls_pool, metrics=metrics, clock=self.clock)
+        self.chain = BeaconChain(preset, cfg, genesis, bls_pool, db=db, metrics=metrics, clock=self.clock)
         self.pending_attestations: List = []
 
     # -- inline validator duties (validator/src/services analogs) -------------

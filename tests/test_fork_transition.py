@@ -56,7 +56,7 @@ def test_dev_chain_crosses_altair_and_bellatrix_and_finalizes():
         assert state.current_justified_checkpoint.epoch >= 4, "no justification"
         assert state.finalized_checkpoint.epoch >= 3, "no finalization"
         # sync aggregates carried real participation
-        head_block = dev.chain.blocks[dev.chain.head_root].message
+        head_block = dev.chain.get_block_by_root(dev.chain.head_root).message
         bits = list(head_block.body.sync_aggregate.sync_committee_bits)
         assert any(bits), "sync aggregate has no participants"
         pool.close()

@@ -68,12 +68,15 @@ def make_attestation(env, bit=0, slot=None, committee_index=0, bad_sig=False):
     slot = slot if slot is not None else env.state.slot
     committee = env.ctx.get_beacon_committee(slot, committee_index)
     epoch = compute_epoch_at_slot(dev.p, slot)
+    # spec target root: the epoch-boundary ancestor of the attested head
+    epoch_start = epoch * dev.p.SLOTS_PER_EPOCH
+    target_root = dev.chain.fork_choice.get_ancestor(dev.chain.head_root, epoch_start)
     data = Fields(
         slot=slot,
         index=committee_index,
         beacon_block_root=dev.chain.head_root,
         source=env.state.current_justified_checkpoint,
-        target=Fields(epoch=epoch, root=dev.chain.head_root),
+        target=Fields(epoch=epoch, root=target_root),
     )
     domain = get_domain(dev.p, env.state, DOMAIN_BEACON_ATTESTER, epoch)
     root = compute_signing_root(dev.p, T.AttestationData, data, domain)

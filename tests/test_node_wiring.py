@@ -1,0 +1,145 @@
+"""Integration: the wired node — regen-backed imports, BeaconDb
+persistence, op-pool block packing, gossip handler routing, archiver
+migration, and restart-from-disk.
+
+VERDICT r2 #5/#7 done-criteria; reference flows: chain/regen/queued.ts:27,
+chain/factory/block/body.ts:48-82, network/processor/gossipHandlers.ts,
+chain/archiver/index.ts:21.
+"""
+
+import asyncio
+
+from lodestar_tpu.chain.bls_pool import BlsBatchPool
+from lodestar_tpu.chain.handlers import GossipHandlers
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.db.beacon import BeaconDb
+from lodestar_tpu.db.controller import MemoryDbController
+from lodestar_tpu.node.dev_chain import DevChain
+from lodestar_tpu.params import DOMAIN_VOLUNTARY_EXIT, MINIMAL
+from lodestar_tpu.ssz import Fields
+from lodestar_tpu.state_transition import (
+    compute_epoch_at_slot,
+    compute_signing_root,
+    get_domain,
+)
+from lodestar_tpu.types import get_types
+
+CFG = ChainConfig(
+    PRESET_BASE="minimal", SHARD_COMMITTEE_PERIOD=0, MIN_GENESIS_TIME=0,
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=32,
+    ALTAIR_FORK_EPOCH=2**64 - 1, BELLATRIX_FORK_EPOCH=2**64 - 1,
+)
+T = get_types(MINIMAL).phase0
+N = 32
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_exit(dev, validator_index: int):
+    state = dev.chain.head_state()
+    epoch = compute_epoch_at_slot(dev.p, state.slot)
+    msg = Fields(epoch=0, validator_index=validator_index)
+    domain = get_domain(dev.p, state, DOMAIN_VOLUNTARY_EXIT, epoch)
+    root = compute_signing_root(dev.p, T.VoluntaryExit, msg, domain)
+    sig = dev.keys[validator_index].sign(root).to_bytes()
+    return Fields(message=msg, signature=sig)
+
+
+def test_wired_node_end_to_end():
+    async def main():
+        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        db = BeaconDb(MINIMAL, MemoryDbController())
+        dev = DevChain(MINIMAL, CFG, N, pool, db=db)
+        chain = dev.chain
+        handlers = GossipHandlers(chain)
+
+        # run long enough to finalize -> archiver migrates hot -> archive
+        await dev.run(4 * MINIMAL.SLOTS_PER_EPOCH + 2)
+        assert chain.head_state().finalized_checkpoint.epoch >= 1
+
+        # archiver moved finalized blocks out of the hot bucket
+        archived = list(
+            db.archived_blocks_by_slot_range(0, MINIMAL.SLOTS_PER_EPOCH + 1)
+        )
+        assert archived, "no blocks archived after finalization"
+        assert db.last_archived_slot() is not None, "finalized state not archived"
+
+        # exit via the gossip handler -> op pool
+        exit_msg = make_exit(dev, 5)
+        await handlers.on_voluntary_exit(exit_msg)
+        assert 5 in chain.op_pool.voluntary_exits
+
+        # produce a block: it must pack pool attestations + our exit
+        slot = chain.head_state().slot + 1
+        state = chain.head_state()
+        randao = dev._sign_randao(
+            state,
+            proposer=self_proposer(dev, slot),
+            epoch=compute_epoch_at_slot(dev.p, slot),
+        )
+        block, proposer = chain.produce_block(slot, randao)
+        assert len(block.body.voluntary_exits) == 1
+        # dev.run leaves aggregated attestations in the pool via its flow?
+        # the dev chain currently passes attestations explicitly; seed the
+        # aggregated pool and produce again to check pool packing
+        att = dev.pending_attestations[-1] if dev.pending_attestations else None
+        if att is not None:
+            chain.agg_pool.add(att)
+            block2, _ = chain.produce_block(slot, randao)
+            assert len(block2.body.attestations) >= 1
+
+        # import the produced block through the normal path
+        sig = dev._sign_block(state, block, proposer)
+        signed = Fields(message=block, signature=sig)
+        root = await chain.process_block(signed)
+        assert chain.fork_choice.has_block(root)
+
+        # state LRU is bounded: no unbounded per-root dict anymore
+        assert len(chain.state_cache) <= chain.state_cache.max_states
+
+        # regen on cache miss: evict everything but genesis, re-ask for head
+        head_root = chain.head_root
+        head_state_root = T.BeaconState.hash_tree_root(chain.head_state())
+        chain.state_cache._map.clear()
+        anchor = chain.fork_choice.proto.nodes[0].block_root
+        chain.state_cache.add(anchor, chain.genesis_state)
+        # walking hot + archived blocks from the db must rebuild the state
+        rebuilt = chain.regen.get_state_by_block_root(head_root, max_replay=64)
+        assert T.BeaconState.hash_tree_root(rebuilt) == head_state_root
+
+        # restart from disk: a fresh chain over the same controller resumes
+        # from the archived finalized state + blocks
+        db2 = BeaconDb(MINIMAL, db.db)
+        resumed_state = db2.last_archived_state()
+        assert resumed_state is not None
+        dev2 = DevChain(MINIMAL, CFG, N, pool, db=db2)
+        # replay archived+hot blocks above the resumed state onto a chain
+        # anchored at genesis (full replay — checkpoint-anchored boot is the
+        # CLI layer's job)
+        count = 0
+        for blk in db2.archived_blocks_by_slot_range(1, 10_000):
+            await dev2.chain.process_block(blk)
+            count += 1
+        hot = sorted(
+            (db2.block.get(k) for k in db2.block.keys()),
+            key=lambda b: b.message.slot,
+        )
+        for blk in hot:
+            if blk.message.slot > dev2.chain.head_state().slot:
+                await dev2.chain.process_block(blk)
+                count += 1
+        assert count > 0
+        assert dev2.chain.head_root == chain.head_root
+        pool.close()
+
+    def self_proposer(dev, slot):
+        from lodestar_tpu.state_transition import clone_state, process_slots
+
+        st = clone_state(dev.p, dev.chain.head_state())
+        ctx = process_slots(dev.p, CFG, st, slot)
+        return ctx.get_beacon_proposer(slot)
+
+    run(main())

@@ -49,7 +49,8 @@ def test_regen_replays_from_cached_ancestor():
         anchor_root = chain.fork_choice.proto.nodes[0].block_root
         cache = StateContextCache()
         cache.add(anchor_root, chain.genesis_state)
-        regen = StateRegenerator(MINIMAL, CFG, chain.blocks, cache)
+        from lodestar_tpu.chain.beacon_chain import _DbBlockSource
+        regen = StateRegenerator(MINIMAL, CFG, _DbBlockSource(chain.db), cache)
 
         head_state = regen.get_state_by_block_root(chain.head_root)
         want = T.BeaconState.hash_tree_root(chain.head_state())
