@@ -1,0 +1,119 @@
+"""Gossip router: topic pub/sub with first-seen dedup and flood publish.
+
+Reference: packages/beacon-node/src/network/gossip/ (gossipsub.ts:84 topic
+handling, topic.ts encoding).  Topic strings follow the spec shape
+``/eth2/<fork_digest_hex>/<name>/ssz_snappy``; message ids are
+sha256(topic | data) — the gossipsub v1.1 message-id function reduced to
+its dedup role.  Mesh management/scoring is not modeled; publish floods to
+all connected peers, which is exact for the node counts the in-process
+tests and LAN deployments here target.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Awaitable, Callable, Dict, List, Optional
+
+from ..utils.logger import get_logger
+
+logger = get_logger("gossip")
+
+TOPIC_BLOCK = "beacon_block"
+TOPIC_AGGREGATE = "beacon_aggregate_and_proof"
+TOPIC_ATTESTATION = "beacon_attestation_{subnet}"
+TOPIC_EXIT = "voluntary_exit"
+TOPIC_PROPOSER_SLASHING = "proposer_slashing"
+TOPIC_ATTESTER_SLASHING = "attester_slashing"
+
+
+def topic_string(fork_digest: bytes, name: str) -> str:
+    return f"/eth2/{fork_digest.hex()}/{name}/ssz_snappy"
+
+
+def parse_topic(topic: str) -> Optional[str]:
+    parts = topic.split("/")
+    if len(parts) == 5 and parts[1] == "eth2" and parts[4] == "ssz_snappy":
+        return parts[3]
+    return None
+
+
+class SeenMessages:
+    """Message-id LRU (gossipsub seenCache)."""
+
+    def __init__(self, max_size: int = 8192):
+        self.max_size = max_size
+        self._seen: "OrderedDict[bytes, None]" = OrderedDict()
+
+    def check_and_add(self, msg_id: bytes) -> bool:
+        """True if newly seen."""
+        if msg_id in self._seen:
+            return False
+        self._seen[msg_id] = None
+        while len(self._seen) > self.max_size:
+            self._seen.popitem(last=False)
+        return True
+
+
+def message_id(topic: str, data: bytes) -> bytes:
+    return hashlib.sha256(topic.encode() + b"\x00" + data).digest()[:20]
+
+
+class GossipRouter:
+    """Binds topic subscriptions to handler coroutines and floods publishes
+    to peers.  Transport-agnostic: `send_fns` are per-peer async callables
+    (topic, ssz_bytes) -> None registered by the Network."""
+
+    def __init__(self):
+        self.subscriptions: Dict[str, Callable[[bytes], Awaitable[None]]] = {}
+        self.seen = SeenMessages()
+        self.send_fns: List[Callable[[str, bytes], Awaitable[None]]] = []
+
+    def subscribe(self, topic: str, handler: Callable[[bytes], Awaitable[None]]) -> None:
+        self.subscriptions[topic] = handler
+
+    def add_peer_sender(self, fn: Callable[[str, bytes], Awaitable[None]]) -> None:
+        self.send_fns.append(fn)
+
+    def remove_peer_sender(self, fn) -> None:
+        if fn in self.send_fns:
+            self.send_fns.remove(fn)
+
+    async def publish(self, topic: str, ssz_bytes: bytes) -> int:
+        """Flood to peers (marks the message seen so the echo is dropped).
+        Returns the number of peers sent to."""
+        self.seen.check_and_add(message_id(topic, ssz_bytes))
+        n = 0
+        for fn in list(self.send_fns):
+            try:
+                await fn(topic, ssz_bytes)
+                n += 1
+            except Exception as e:  # noqa: BLE001
+                logger.warning("gossip publish to peer failed: %s", e)
+        return n
+
+    async def on_message(self, topic: str, ssz_bytes: bytes, *, forward: bool = True) -> None:
+        """Inbound message: dedup -> local handler -> re-flood (the
+        IGNORE/REJECT semantics live in the handler: it raises
+        GossipValidationError and we drop without forwarding)."""
+        if not self.seen.check_and_add(message_id(topic, ssz_bytes)):
+            return
+        handler = self.subscriptions.get(topic)
+        if handler is None:
+            return
+        from ..chain.validation import GossipValidationError
+
+        try:
+            await handler(ssz_bytes)
+        except GossipValidationError as e:
+            logger.debug("gossip %s: %s", topic, e)
+            return  # IGNORE and REJECT both stop propagation here
+        except Exception as e:  # noqa: BLE001
+            logger.warning("gossip handler error on %s: %s", topic, e)
+            return
+        if forward:
+            for fn in list(self.send_fns):
+                try:
+                    await fn(topic, ssz_bytes)
+                except Exception:
+                    pass

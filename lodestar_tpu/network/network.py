@@ -1,0 +1,192 @@
+"""Network: TCP listener/dialer tying wire frames to req/resp + gossip.
+
+Reference: packages/beacon-node/src/network/network.ts:41 — the object a
+node owns: transport lifecycle, peer manager, req/resp endpoint per peer,
+gossip router bound to the chain's gossip handlers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional
+
+from ..params import Preset
+from ..types import get_types
+from ..utils.logger import get_logger
+from .gossip import (
+    TOPIC_AGGREGATE,
+    TOPIC_ATTESTATION,
+    TOPIC_ATTESTER_SLASHING,
+    TOPIC_BLOCK,
+    TOPIC_EXIT,
+    TOPIC_PROPOSER_SLASHING,
+    GossipRouter,
+    parse_topic,
+    topic_string,
+)
+from .peer import Peer, PeerManager
+from .reqresp import ReqRespNode
+from .wire import KIND_GOSSIP, KIND_REQUEST, KIND_RESPONSE_CHUNK, KIND_RESPONSE_END, Wire
+
+logger = get_logger("network")
+
+
+class Network:
+    def __init__(self, preset: Preset, chain, gossip_handlers=None, host: str = "127.0.0.1"):
+        self.p = preset
+        self.chain = chain
+        self.handlers = gossip_handlers
+        self.host = host
+        self.port: Optional[int] = None
+        self.peer_manager = PeerManager()
+        self.router = GossipRouter()
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._peer_seq = 0
+        self.t = get_types(preset).phase0
+        if gossip_handlers is not None:
+            self._subscribe_core_topics()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def listen(self, port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._on_inbound, self.host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("listening on %s:%d", self.host, self.port)
+        return self.port
+
+    async def connect(self, host: str, port: int) -> Peer:
+        reader, writer = await asyncio.open_connection(host, port)
+        return await self._setup_peer(reader, writer, initiator=True)
+
+    async def close(self) -> None:
+        for peer in self.peer_manager.connected():
+            await self._drop_peer(peer, goodbye=True)
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # -- connection plumbing ---------------------------------------------------
+
+    async def _on_inbound(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        await self._setup_peer(reader, writer, initiator=False)
+
+    async def _setup_peer(self, reader, writer, *, initiator: bool) -> Peer:
+        self._peer_seq += 1
+        peer_id = f"peer-{id(self) & 0xFFFF:x}-{self._peer_seq}"
+        wire = Wire(reader, writer)
+        reqresp = ReqRespNode(self.p, self.chain, wire)
+        peer = Peer(peer_id=peer_id, reqresp=reqresp, wire=wire)
+
+        async def gossip_send(topic: str, ssz_bytes: bytes) -> None:
+            await wire.send_frame(KIND_GOSSIP, Wire.encode_gossip(topic, ssz_bytes))
+
+        peer._gossip_send = gossip_send
+        self.router.add_peer_sender(gossip_send)
+        self.peer_manager.add(peer)
+        task = asyncio.create_task(self._read_loop(peer))
+        peer.tasks.append(task)
+        if initiator:
+            await self.peer_manager.handshake(peer, reqresp.local_status())
+        return peer
+
+    async def _read_loop(self, peer: Peer) -> None:
+        try:
+            while True:
+                kind, payload = await peer.wire.recv_frame()
+                if kind == KIND_REQUEST:
+                    asyncio.ensure_future(peer.reqresp.on_request_frame(payload))
+                elif kind in (KIND_RESPONSE_CHUNK, KIND_RESPONSE_END):
+                    peer.reqresp.on_response_frame(kind, payload)
+                elif kind == KIND_GOSSIP:
+                    topic, data = Wire.decode_gossip(payload)
+                    await self.router.on_message(topic, data)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        except Exception as e:  # noqa: BLE001
+            logger.warning("peer %s read loop error: %s", peer.peer_id, e)
+        finally:
+            await self._drop_peer(peer, goodbye=False)
+
+    async def _drop_peer(self, peer: Peer, *, goodbye: bool) -> None:
+        if self.peer_manager.get(peer.peer_id) is None:
+            return
+        if goodbye:
+            await peer.reqresp.goodbye()
+        self.peer_manager.remove(peer.peer_id)
+        self.router.remove_peer_sender(getattr(peer, "_gossip_send", None))
+        peer.wire.close()
+        for t in peer.tasks:
+            if t is not asyncio.current_task():
+                t.cancel()
+
+    # -- gossip binding --------------------------------------------------------
+
+    def _fork_digest(self) -> bytes:
+        from ..state_transition import compute_fork_digest
+
+        state = self.chain.head_state()
+        return compute_fork_digest(
+            self.p, bytes(state.fork.current_version), bytes(state.genesis_validators_root)
+        )
+
+    def _subscribe_core_topics(self) -> None:
+        """Bind the spec topics to the chain's gossip handlers with SSZ
+        decode at the boundary (gossipsub.ts topic handler table)."""
+        digest = self._fork_digest()
+        h = self.handlers
+        t = self.t
+
+        async def on_block(data: bytes) -> None:
+            from ..db.beacon import _FORK_ORDER
+
+            all_t = get_types(self.p)
+            ft = getattr(all_t, _FORK_ORDER[data[0]])
+            await h.on_block(ft.SignedBeaconBlock.deserialize(data[1:]))
+
+        async def on_aggregate(data: bytes) -> None:
+            await h.on_aggregate_and_proof(t.SignedAggregateAndProof.deserialize(data))
+
+        async def on_exit(data: bytes) -> None:
+            await h.on_voluntary_exit(t.SignedVoluntaryExit.deserialize(data))
+
+        async def on_prop_slashing(data: bytes) -> None:
+            await h.on_proposer_slashing(t.ProposerSlashing.deserialize(data))
+
+        async def on_att_slashing(data: bytes) -> None:
+            await h.on_attester_slashing(t.AttesterSlashing.deserialize(data))
+
+        self.router.subscribe(topic_string(digest, TOPIC_BLOCK), on_block)
+        self.router.subscribe(topic_string(digest, TOPIC_AGGREGATE), on_aggregate)
+        self.router.subscribe(topic_string(digest, TOPIC_EXIT), on_exit)
+        self.router.subscribe(topic_string(digest, TOPIC_PROPOSER_SLASHING), on_prop_slashing)
+        self.router.subscribe(topic_string(digest, TOPIC_ATTESTER_SLASHING), on_att_slashing)
+        for subnet in range(4):  # attestation subnets (subset; attnets v1)
+            topic = topic_string(digest, TOPIC_ATTESTATION.format(subnet=subnet))
+
+            async def on_att(data: bytes, _subnet=subnet) -> None:
+                await h.on_attestation(t.Attestation.deserialize(data), subnet=_subnet)
+
+            self.router.subscribe(topic, on_att)
+
+    # -- publish helpers (network.ts publishBeaconBlock etc.) ------------------
+
+    async def publish_block(self, signed_block) -> int:
+        from ..db.beacon import _FORK_ORDER
+        from ..state_transition.upgrade import block_fork_name
+
+        fork = block_fork_name(signed_block.message).value
+        all_t = get_types(self.p)
+        data = bytes([_FORK_ORDER.index(fork)]) + getattr(all_t, fork).SignedBeaconBlock.serialize(
+            signed_block
+        )
+        return await self.router.publish(topic_string(self._fork_digest(), TOPIC_BLOCK), data)
+
+    async def publish_attestation(self, attestation, subnet: int = 0) -> int:
+        data = self.t.Attestation.serialize(attestation)
+        return await self.router.publish(
+            topic_string(self._fork_digest(), TOPIC_ATTESTATION.format(subnet=subnet)), data
+        )
+
+    async def publish_voluntary_exit(self, signed_exit) -> int:
+        data = self.t.SignedVoluntaryExit.serialize(signed_exit)
+        return await self.router.publish(topic_string(self._fork_digest(), TOPIC_EXIT), data)

@@ -1,0 +1,70 @@
+"""Peer bookkeeping: connection state, status handshake, scoring stub.
+
+Reference: packages/beacon-node/src/network/peers/peerManager.ts:105
+(status handshake on connect, ping/metadata upkeep, goodbye on prune) and
+peers/score.ts (kept minimal: a misbehavior counter that gates pruning).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..utils.logger import get_logger
+
+logger = get_logger("peers")
+
+
+@dataclass
+class Peer:
+    peer_id: str
+    reqresp: object  # ReqRespNode
+    wire: object  # Wire
+    status: Optional[object] = None  # last Status from the peer
+    metadata: Optional[object] = None
+    score: int = 0
+    tasks: List[asyncio.Task] = field(default_factory=list)
+
+    def penalize(self, points: int = 1) -> None:
+        self.score -= points
+
+
+class PeerManager:
+    def __init__(self, max_peers: int = 55):
+        self.max_peers = max_peers
+        self.peers: Dict[str, Peer] = {}
+
+    def add(self, peer: Peer) -> None:
+        self.peers[peer.peer_id] = peer
+
+    def remove(self, peer_id: str) -> Optional[Peer]:
+        return self.peers.pop(peer_id, None)
+
+    def get(self, peer_id: str) -> Optional[Peer]:
+        return self.peers.get(peer_id)
+
+    def connected(self) -> List[Peer]:
+        return list(self.peers.values())
+
+    def best_peer_for_sync(self) -> Optional[Peer]:
+        """Peer with the highest advertised head slot (rangeSync picks its
+        target chain from peer statuses — range.ts:76)."""
+        best = None
+        for p in self.peers.values():
+            if p.status is None:
+                continue
+            if best is None or p.status.head_slot > best.status.head_slot:
+                best = p
+        return best
+
+    async def handshake(self, peer: Peer, local_status) -> object:
+        """Exchange Status on connect (peerManager onConnect flow); stores
+        and returns the peer's status."""
+        status = await peer.reqresp.status(local_status)
+        peer.status = status
+        try:
+            peer.metadata = await peer.reqresp.metadata()
+        except Exception:
+            peer.metadata = None
+        return status

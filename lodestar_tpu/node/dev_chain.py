@@ -181,6 +181,25 @@ class DevChain:
         logger.debug("slot %d: head %s", slot, root.hex()[:12])
         return root
 
+    async def produce_and_import_block(self, slot: int, attestations=()):
+        """Produce, sign, import and RETURN the signed block for `slot`
+        (no attestation flow) — the building block for network tests and
+        external publishers."""
+        head_state = self.chain.head_state()
+        pre = clone_state(self.p, head_state)
+        ctx = process_slots(self.p, self.cfg, pre, slot)
+        proposer = ctx.get_beacon_proposer(slot)
+        epoch = compute_epoch_at_slot(self.p, slot)
+        randao = self._sign_randao(pre, proposer, epoch)
+        sync_aggregate = self._sign_sync_aggregate(pre)
+        block, _ = self.chain.produce_block(
+            slot, randao, attestations=list(attestations), sync_aggregate=sync_aggregate
+        )
+        sig = self._sign_block(pre, block, proposer)
+        signed = Fields(message=block, signature=sig)
+        await self.chain.process_block(signed)
+        return signed
+
     async def run(self, n_slots: int, with_attestations: bool = True) -> None:
         state = self.chain.head_state()
         start = state.slot + 1

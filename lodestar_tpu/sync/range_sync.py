@@ -1,0 +1,78 @@
+"""Range sync: batched beaconBlocksByRange towards the best peer's head.
+
+Reference: packages/beacon-node/src/sync/range/range.ts:76 (SyncChain over
+batches) and sync.ts:16 (state machine: stalled -> syncing -> synced).
+The batch pipeline is sequential here (one in-flight batch); the
+reference's EPOCHS_PER_BATCH=2 batching and import-via-processChainSegment
+semantics are kept.  Bulk segments are exactly the >=1000-set workloads
+the batched TPU verifier wants (SURVEY §2.6).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+from typing import Optional
+
+from ..params import Preset
+from ..utils.logger import get_logger
+
+logger = get_logger("range-sync")
+
+EPOCHS_PER_BATCH = 2
+
+
+class SyncState(str, enum.Enum):
+    stalled = "stalled"
+    syncing = "syncing"
+    synced = "synced"
+
+
+class RangeSync:
+    def __init__(self, preset: Preset, chain, peer_manager):
+        self.p = preset
+        self.chain = chain
+        self.peers = peer_manager
+        self.state = SyncState.stalled
+        self.batch_size = EPOCHS_PER_BATCH * preset.SLOTS_PER_EPOCH
+
+    def _local_head_slot(self) -> int:
+        return self.chain.head_state().slot
+
+    async def run_to_head(self, max_batches: int = 1000) -> int:
+        """Sync until the local head reaches the best peer's advertised
+        head.  Returns imported block count."""
+        imported = 0
+        batches = 0
+        while batches < max_batches:
+            peer = self.peers.best_peer_for_sync()
+            if peer is None or peer.status is None:
+                self.state = SyncState.stalled
+                return imported
+            target = peer.status.head_slot
+            local = self._local_head_slot()
+            if local >= target:
+                self.state = SyncState.synced
+                return imported
+            self.state = SyncState.syncing
+            start = local + 1
+            count = min(self.batch_size, target - local)
+            blocks = await peer.reqresp.blocks_by_range(start, count)
+            batches += 1
+            if not blocks:
+                # empty batch for a non-empty range: peer has nothing for
+                # us here (skipped slots at the tip) — treat as done
+                self.state = SyncState.synced
+                return imported
+            try:
+                imported += await self.chain.process_chain_segment(blocks)
+            except Exception as e:  # noqa: BLE001
+                peer.penalize(10)
+                logger.warning("segment import failed: %s", e)
+                self.state = SyncState.stalled
+                return imported
+            logger.info(
+                "range sync: imported %d blocks (head %d / target %d)",
+                len(blocks), self._local_head_slot(), target,
+            )
+        return imported

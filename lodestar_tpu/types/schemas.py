@@ -263,6 +263,10 @@ def _phase0(p: Preset) -> ForkTypes:
         "BeaconBlocksByRangeRequest",
         [("start_slot", Slot), ("count", uint64), ("step", uint64)],
     )
+    t.BeaconBlocksByRootRequest = Container(
+        "BeaconBlocksByRootRequest",
+        [("roots", List(Root, 1024))],
+    )
     t.Eth1Block = Container(
         "Eth1Block",
         [("timestamp", uint64), ("deposit_root", Root), ("deposit_count", uint64)],

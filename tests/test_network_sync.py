@@ -1,0 +1,112 @@
+"""Two-node loopback tests: reqresp handshake, range sync, gossip block
+propagation, unknown-block resolution.
+
+VERDICT r2 #6 done-criterion (node B range-syncs N epochs from node A and
+reaches the same head); reference precedent:
+beacon-node/test/sim/multiNodeSingleThread.test.ts and
+network/reqresp e2e tests.
+"""
+
+import asyncio
+
+from lodestar_tpu.chain.bls_pool import BlsBatchPool
+from lodestar_tpu.chain.handlers import GossipHandlers
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.network import Network
+from lodestar_tpu.node.dev_chain import DevChain
+from lodestar_tpu.params import MINIMAL
+from lodestar_tpu.ssz import Fields
+from lodestar_tpu.sync import RangeSync, SyncState, UnknownBlockSync
+
+CFG = ChainConfig(
+    PRESET_BASE="minimal", SHARD_COMMITTEE_PERIOD=0, MIN_GENESIS_TIME=0,
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
+    ALTAIR_FORK_EPOCH=2**64 - 1, BELLATRIX_FORK_EPOCH=2**64 - 1,
+)
+N = 16
+
+
+def make_pair():
+    """Two dev nodes sharing genesis (same interop keys/time)."""
+    pool_a = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+    pool_b = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+    a = DevChain(MINIMAL, CFG, N, pool_a)
+    b = DevChain(MINIMAL, CFG, N, pool_b)
+    return a, b, pool_a, pool_b
+
+
+def test_handshake_and_range_sync():
+    async def main():
+        a, b, pool_a, pool_b = make_pair()
+        # node A advances 2.5 epochs; B stays at genesis
+        await a.run(2 * MINIMAL.SLOTS_PER_EPOCH + 4, with_attestations=False)
+
+        net_a = Network(MINIMAL, a.chain, GossipHandlers(a.chain))
+        net_b = Network(MINIMAL, b.chain, GossipHandlers(b.chain))
+        port = await net_a.listen(0)
+        peer = await net_b.connect("127.0.0.1", port)
+
+        # handshake stored A's status on B's peer record
+        assert peer.status is not None
+        assert peer.status.head_slot == a.chain.head_state().slot
+
+        # ping + metadata round-trip
+        assert await peer.reqresp.ping(7) == 7
+        md = await peer.reqresp.metadata()
+        assert md.seq_number == 0
+
+        # range sync B -> A's head
+        sync = RangeSync(MINIMAL, b.chain, net_b.peer_manager)
+        imported = await sync.run_to_head()
+        assert sync.state == SyncState.synced
+        assert imported > 0
+        assert b.chain.head_root == a.chain.head_root
+
+        await net_b.close()
+        await net_a.close()
+        pool_a.close()
+        pool_b.close()
+
+    asyncio.run(main())
+
+
+def test_gossip_block_propagation_and_unknown_parent():
+    async def main():
+        a, b, pool_a, pool_b = make_pair()
+        net_a = Network(MINIMAL, a.chain, GossipHandlers(a.chain))
+        net_b = Network(MINIMAL, b.chain, GossipHandlers(b.chain))
+        port = await net_a.listen(0)
+        await net_b.connect("127.0.0.1", port)
+
+        # A produces a block for slot 1 and publishes it; B imports via the
+        # gossip handler path
+        signed = await a.produce_and_import_block(1)
+        n_sent = await net_a.publish_block(signed)
+        assert n_sent == 1
+        for _ in range(100):  # poll: import includes STF + batch verify
+            if b.chain.head_root == a.chain.head_root:
+                break
+            await asyncio.sleep(0.1)
+        assert b.chain.head_root == a.chain.head_root
+
+        # A advances two more blocks silently, then publishes only the tip:
+        # B resolves ancestors via blocks_by_root (unknown-block sync)
+        s2 = await a.produce_and_import_block(2)
+        s3 = await a.produce_and_import_block(3)
+        # B hasn't seen s2; hand s3 to the resolver directly (the gossip
+        # path would surface BlockError: unknown parent first)
+        ub = UnknownBlockSync(MINIMAL, b.chain, net_b.peer_manager)
+        # B needs a peer status to pick a sync peer
+        peer_b = net_b.peer_manager.connected()[0]
+        await net_b.peer_manager.handshake(peer_b, peer_b.reqresp.local_status())
+        ok = await ub.resolve(s3)
+        assert ok
+        assert b.chain.head_root == a.chain.head_root
+
+        await net_b.close()
+        await net_a.close()
+        pool_a.close()
+        pool_b.close()
+
+    asyncio.run(main())
