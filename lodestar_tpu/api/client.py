@@ -1,0 +1,69 @@
+"""Minimal asyncio HTTP client for the beacon REST API.
+
+Reference: packages/api/src/beacon/client (the typed fetch wrappers the
+validator package builds on).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Optional
+
+from ..utils.logger import get_logger
+
+logger = get_logger("api-client")
+
+
+class ApiClient:
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+
+    async def _request(self, method: str, path: str, body: Any = None) -> Any:
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        try:
+            data = json.dumps(body).encode() if body is not None else b""
+            req = (
+                f"{method} {path} HTTP/1.1\r\n"
+                f"host: {self.host}\r\n"
+                "connection: close\r\n"
+                "content-type: application/json\r\n"
+                f"content-length: {len(data)}\r\n\r\n"
+            ).encode() + data
+            writer.write(req)
+            await writer.drain()
+            status_line = await reader.readline()
+            status = int(status_line.split()[1])
+            headers = {}
+            while True:
+                h = await reader.readline()
+                if h in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = h.decode().partition(":")
+                headers[k.strip().lower()] = v.strip()
+            payload = await reader.read()
+            if "content-length" in headers:
+                payload = payload[: int(headers["content-length"])] if payload else payload
+            out = json.loads(payload) if payload and headers.get("content-type", "").startswith("application/json") else payload
+            if status >= 400:
+                raise ApiClientError(status, out)
+            return out
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def get(self, path: str) -> Any:
+        return await self._request("GET", path)
+
+    async def post(self, path: str, body: Any) -> Any:
+        return await self._request("POST", path, body)
+
+
+class ApiClientError(Exception):
+    def __init__(self, status: int, body: Any):
+        super().__init__(f"HTTP {status}: {body}")
+        self.status = status
+        self.body = body

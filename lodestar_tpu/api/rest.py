@@ -1,0 +1,424 @@
+"""Asyncio HTTP/1.1 REST server with the beacon/node/validator routes a
+validator client needs.
+
+Reference: beacon-node/src/api/rest/index.ts:36 (server),
+api/impl/validator/index.ts:169-222 (duties + production),
+api/impl/beacon/ (genesis/headers/blocks/pool).  Routes implemented:
+
+  GET  /eth/v1/node/health
+  GET  /eth/v1/node/version
+  GET  /eth/v1/node/syncing
+  GET  /eth/v1/beacon/genesis
+  GET  /eth/v1/beacon/states/{state_id}/finality_checkpoints
+  GET  /eth/v1/beacon/states/{state_id}/validators/{validator_id}
+  GET  /eth/v1/beacon/headers/{block_id}
+  GET  /eth/v1/validator/duties/proposer/{epoch}
+  POST /eth/v1/validator/duties/attester/{epoch}
+  GET  /eth/v2/validator/blocks/{slot}?randao_reveal=0x..
+  POST /eth/v1/beacon/blocks
+  GET  /eth/v1/validator/attestation_data?slot=&committee_index=
+  POST /eth/v1/beacon/pool/attestations
+  POST /eth/v1/beacon/pool/voluntary_exits
+  GET  /metrics  (prometheus text exposition when a registry is wired)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+from typing import Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..params import Preset
+from ..ssz import Fields
+from ..state_transition import (
+    clone_state,
+    compute_epoch_at_slot,
+    compute_start_slot_at_epoch,
+    process_slots,
+)
+from ..types import get_types
+from ..utils.logger import get_logger
+from .serde import from_json, to_json
+
+logger = get_logger("rest-api")
+
+VERSION = "lodestar-tpu/0.3.0"
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class RestApiServer:
+    def __init__(self, preset: Preset, chain, network=None, metrics_registry=None,
+                 host: str = "127.0.0.1"):
+        self.p = preset
+        self.chain = chain
+        self.network = network
+        self.metrics_registry = metrics_registry
+        self.host = host
+        self.port: Optional[int] = None
+        self.t = get_types(preset).phase0
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._routes: List[Tuple[str, re.Pattern, Callable]] = []
+        self._register_routes()
+
+    # -- lifecycle -------------------------------------------------------------
+
+    async def listen(self, port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._handle_conn, self.host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("REST API on http://%s:%d", self.host, self.port)
+        return self.port
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # -- http plumbing ---------------------------------------------------------
+
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                try:
+                    method, target, _version = line.decode().split()
+                except ValueError:
+                    break
+                headers = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                if "content-length" in headers:
+                    body = await reader.readexactly(int(headers["content-length"]))
+                status, payload, ctype = await self._dispatch(method, target, body)
+                data = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
+                writer.write(
+                    b"HTTP/1.1 %d %s\r\n" % (status, b"OK" if status < 400 else b"Error")
+                    + b"content-type: %s\r\n" % ctype.encode()
+                    + b"content-length: %d\r\n\r\n" % len(data)
+                    + data
+                )
+                await writer.drain()
+                if headers.get("connection", "").lower() == "close":
+                    break
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, method: str, target: str, body: bytes):
+        parsed = urlparse(target)
+        path = parsed.path
+        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        for m, pat, fn in self._routes:
+            if m != method:
+                continue
+            match = pat.fullmatch(path)
+            if match:
+                try:
+                    payload = fn(match.groupdict(), query, json.loads(body) if body else None)
+                    if asyncio.iscoroutine(payload):
+                        payload = await payload
+                    if isinstance(payload, tuple):  # (bytes, content-type)
+                        return 200, payload[0], payload[1]
+                    return 200, payload, "application/json"
+                except ApiError as e:
+                    return e.status, {"code": e.status, "message": e.message}, "application/json"
+                except Exception as e:  # noqa: BLE001
+                    logger.warning("api error on %s: %s", path, e)
+                    return 500, {"code": 500, "message": str(e)}, "application/json"
+        return 404, {"code": 404, "message": f"route not found: {method} {path}"}, "application/json"
+
+    def _route(self, method: str, pattern: str, fn: Callable) -> None:
+        # {name} -> named group
+        regex = re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern)
+        self._routes.append((method, re.compile(regex), fn))
+
+    # -- route implementations -------------------------------------------------
+
+    def _register_routes(self) -> None:
+        r = self._route
+        r("GET", "/eth/v1/node/health", lambda pp, q, b: {})
+        r("GET", "/eth/v1/node/version", lambda pp, q, b: {"data": {"version": VERSION}})
+        r("GET", "/eth/v1/node/syncing", self._syncing)
+        r("GET", "/eth/v1/beacon/genesis", self._genesis)
+        r("GET", "/eth/v1/beacon/states/{state_id}/finality_checkpoints", self._finality)
+        r("GET", "/eth/v1/beacon/states/{state_id}/validators/{validator_id}", self._validator)
+        r("GET", "/eth/v1/beacon/headers/{block_id}", self._header)
+        r("GET", "/eth/v1/validator/duties/proposer/{epoch}", self._proposer_duties)
+        r("POST", "/eth/v1/validator/duties/attester/{epoch}", self._attester_duties)
+        r("GET", "/eth/v2/validator/blocks/{slot}", self._produce_block)
+        r("POST", "/eth/v1/beacon/blocks", self._publish_block)
+        r("GET", "/eth/v1/validator/attestation_data", self._attestation_data)
+        r("POST", "/eth/v1/beacon/pool/attestations", self._submit_attestations)
+        r("POST", "/eth/v1/beacon/pool/voluntary_exits", self._submit_exit)
+        r("GET", "/eth/v1/validator/aggregate_attestation", self._aggregate_attestation)
+        r("POST", "/eth/v1/validator/aggregate_and_proofs", self._submit_aggregates)
+        r("GET", "/metrics", self._metrics)
+
+    def _state_for(self, state_id: str):
+        chain = self.chain
+        if state_id in ("head", "justified", "finalized"):
+            if state_id == "head":
+                return chain.head_state()
+            cp = (
+                chain.fork_choice.store.justified_checkpoint
+                if state_id == "justified"
+                else chain.fork_choice.store.finalized_checkpoint
+            )
+            st = chain.get_state_by_block_root(cp.root)
+            if st is None:
+                raise ApiError(404, f"state {state_id} not available")
+            return st
+        if state_id.startswith("0x"):
+            st = chain.get_state_by_block_root(bytes.fromhex(state_id[2:]))
+            if st is None:
+                raise ApiError(404, "state not found")
+            return st
+        raise ApiError(400, f"unsupported state id {state_id}")
+
+    def _syncing(self, pp, q, b):
+        head_slot = self.chain.head_state().slot
+        clock_slot = self.chain.clock.current_slot if self.chain.clock else head_slot
+        distance = max(0, clock_slot - head_slot)
+        return {
+            "data": {
+                "head_slot": str(head_slot),
+                "sync_distance": str(distance),
+                "is_syncing": distance > 1,
+                "is_optimistic": False,
+            }
+        }
+
+    def _genesis(self, pp, q, b):
+        gs = self.chain.genesis_state
+        return {
+            "data": {
+                "genesis_time": str(gs.genesis_time),
+                "genesis_validators_root": "0x" + bytes(gs.genesis_validators_root).hex(),
+                "genesis_fork_version": "0x" + bytes(gs.fork.current_version).hex(),
+            }
+        }
+
+    def _finality(self, pp, q, b):
+        st = self._state_for(pp["state_id"])
+        return {
+            "data": {
+                "previous_justified": to_json(st.previous_justified_checkpoint),
+                "current_justified": to_json(st.current_justified_checkpoint),
+                "finalized": to_json(st.finalized_checkpoint),
+            }
+        }
+
+    def _validator(self, pp, q, b):
+        st = self._state_for(pp["state_id"])
+        vid = pp["validator_id"]
+        if vid.startswith("0x"):
+            pk = bytes.fromhex(vid[2:])
+            idx = next(
+                (i for i, v in enumerate(st.validators) if bytes(v.pubkey) == pk), None
+            )
+            if idx is None:
+                raise ApiError(404, "validator not found")
+        else:
+            idx = int(vid)
+            if idx >= len(st.validators):
+                raise ApiError(404, "validator not found")
+        v = st.validators[idx]
+        return {
+            "data": {
+                "index": str(idx),
+                "balance": str(st.balances[idx]),
+                "status": "active_ongoing",
+                "validator": to_json(v),
+            }
+        }
+
+    def _header(self, pp, q, b):
+        block_id = pp["block_id"]
+        chain = self.chain
+        root = chain.head_root if block_id == "head" else (
+            bytes.fromhex(block_id[2:]) if block_id.startswith("0x") else None
+        )
+        if root is None:
+            raise ApiError(400, "unsupported block id")
+        blk = chain.get_block_by_root(root)
+        if blk is None:
+            raise ApiError(404, "block not found")
+        hdr = Fields(
+            slot=blk.message.slot,
+            proposer_index=blk.message.proposer_index,
+            parent_root=bytes(blk.message.parent_root),
+            state_root=bytes(blk.message.state_root),
+            body_root=b"\x00" * 32,
+        )
+        return {
+            "data": {
+                "root": "0x" + root.hex(),
+                "canonical": True,
+                "header": {"message": to_json(hdr), "signature": "0x" + bytes(blk.signature).hex()},
+            }
+        }
+
+    def _duty_state(self, epoch: int):
+        st = clone_state(self.p, self.chain.head_state())
+        start = compute_start_slot_at_epoch(self.p, epoch)
+        ctx = process_slots(self.p, self.chain.cfg, st, max(st.slot, start))
+        return st, ctx
+
+    def _proposer_duties(self, pp, q, b):
+        epoch = int(pp["epoch"])
+        st, ctx = self._duty_state(epoch)
+        start = compute_start_slot_at_epoch(self.p, epoch)
+        duties = []
+        for slot in range(start, start + self.p.SLOTS_PER_EPOCH):
+            if slot == 0:
+                continue  # genesis slot has no proposal
+            proposer = ctx.get_beacon_proposer_at(slot, st) if hasattr(ctx, "get_beacon_proposer_at") else ctx.get_beacon_proposer(slot)
+            duties.append(
+                {
+                    "pubkey": "0x" + bytes(st.validators[proposer].pubkey).hex(),
+                    "validator_index": str(proposer),
+                    "slot": str(slot),
+                }
+            )
+        return {"data": duties, "dependent_root": "0x" + self.chain.head_root.hex()}
+
+    def _attester_duties(self, pp, q, b):
+        epoch = int(pp["epoch"])
+        indices = {int(i) for i in (b or [])}
+        st, ctx = self._duty_state(epoch)
+        start = compute_start_slot_at_epoch(self.p, epoch)
+        duties = []
+        committees_per_slot = ctx.get_committee_count_per_slot(epoch)
+        for slot in range(start, start + self.p.SLOTS_PER_EPOCH):
+            for index in range(committees_per_slot):
+                committee = ctx.get_beacon_committee(slot, index)
+                for pos, vi in enumerate(committee):
+                    if int(vi) in indices:
+                        duties.append(
+                            {
+                                "pubkey": "0x" + bytes(st.validators[int(vi)].pubkey).hex(),
+                                "validator_index": str(int(vi)),
+                                "committee_index": str(index),
+                                "committee_length": str(len(committee)),
+                                "committees_at_slot": str(committees_per_slot),
+                                "validator_committee_index": str(pos),
+                                "slot": str(slot),
+                            }
+                        )
+        return {"data": duties, "dependent_root": "0x" + self.chain.head_root.hex()}
+
+    def _produce_block(self, pp, q, b):
+        slot = int(pp["slot"])
+        randao = bytes.fromhex(q.get("randao_reveal", "0x" + "00" * 96)[2:])
+        block, _proposer = self.chain.produce_block(slot, randao)
+        from ..state_transition.upgrade import block_fork_name
+
+        return {
+            "version": block_fork_name(block).value,
+            "data": to_json(block),
+        }
+
+    async def _publish_block(self, pp, q, b):
+        from ..state_transition.upgrade import block_types
+
+        signed = from_json(b)
+        # normalize list-typed body fields the JSON round-trip flattened
+        root = await self.chain.process_block(signed)
+        if self.network is not None:
+            await self.network.publish_block(signed)
+        return {"data": {"root": "0x" + root.hex()}}
+
+    def _attestation_data(self, pp, q, b):
+        slot = int(q["slot"])
+        index = int(q.get("committee_index", 0))
+        chain = self.chain
+        head_root = chain.head_root
+        st = clone_state(self.p, chain.head_state())
+        process_slots(self.p, chain.cfg, st, max(st.slot, slot))
+        epoch = compute_epoch_at_slot(self.p, slot)
+        boundary = compute_start_slot_at_epoch(self.p, epoch)
+        if boundary >= st.slot:
+            target_root = head_root
+        else:
+            target_root = bytes(st.block_roots[boundary % self.p.SLOTS_PER_HISTORICAL_ROOT])
+        data = Fields(
+            slot=slot,
+            index=index,
+            beacon_block_root=head_root,
+            source=st.current_justified_checkpoint,
+            target=Fields(epoch=epoch, root=target_root),
+        )
+        return {"data": to_json(data)}
+
+    async def _submit_attestations(self, pp, q, b):
+        handlers = getattr(self, "gossip_handlers", None)
+        errors = []
+        for i, att_json in enumerate(b or []):
+            att = from_json(att_json)
+            try:
+                if handlers is not None:
+                    await handlers.on_attestation(att)
+                else:
+                    self.chain.att_pool.add(att)
+                    self.chain.agg_pool.add(att)
+                if self.network is not None:
+                    await self.network.publish_attestation(att)
+            except Exception as e:  # noqa: BLE001
+                errors.append({"index": i, "message": str(e)})
+        if errors:
+            raise ApiError(400, json.dumps(errors))
+        return {}
+
+    async def _submit_exit(self, pp, q, b):
+        signed_exit = from_json(b)
+        self.chain.op_pool.add_voluntary_exit(signed_exit)
+        if self.network is not None:
+            await self.network.publish_voluntary_exit(signed_exit)
+        return {}
+
+    def _aggregate_attestation(self, pp, q, b):
+        slot = int(q["slot"])
+        data_root = bytes.fromhex(q["attestation_data_root"][2:])
+        agg = self.chain.att_pool.get_aggregate(slot, data_root)
+        if agg is None:
+            raise ApiError(404, "no matching attestations in the pool")
+        return {"data": to_json(agg)}
+
+    async def _submit_aggregates(self, pp, q, b):
+        handlers = getattr(self, "gossip_handlers", None)
+        errors = []
+        for i, sa_json in enumerate(b or []):
+            signed_aggregate = from_json(sa_json)
+            try:
+                if handlers is not None:
+                    await handlers.on_aggregate_and_proof(signed_aggregate)
+                else:
+                    self.chain.agg_pool.add(signed_aggregate.message.aggregate)
+            except Exception as e:  # noqa: BLE001
+                errors.append({"index": i, "message": str(e)})
+        if errors:
+            raise ApiError(400, json.dumps(errors))
+        return {}
+
+    def _metrics(self, pp, q, b):
+        if self.metrics_registry is None:
+            raise ApiError(404, "metrics not enabled")
+        return (self.metrics_registry.expose(), "text/plain; version=0.0.4")

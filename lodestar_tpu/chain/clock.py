@@ -51,3 +51,18 @@ class LocalClock:
         delta = self.slot_start_time(slot) - self.now_fn()
         if delta > 0:
             await asyncio.sleep(delta)
+
+
+class ManualClock(LocalClock):
+    """A LocalClock whose time is advanced explicitly (dev chain / tests):
+    ``set_slot(n)`` pins now() to the start of slot n."""
+
+    def __init__(self, genesis_time: int, seconds_per_slot: int, slots_per_epoch: int):
+        self._now = float(genesis_time)
+        super().__init__(genesis_time, seconds_per_slot, slots_per_epoch, now_fn=lambda: self._now)
+
+    def set_slot(self, slot: int, seconds_into: float = 0.0) -> None:
+        self._now = self.genesis_time + slot * self.seconds_per_slot + seconds_into
+
+    async def wait_for_slot(self, slot: int) -> None:
+        self.set_slot(slot)

@@ -15,7 +15,7 @@ from typing import Dict, List, Optional
 
 from ..chain.beacon_chain import BeaconChain
 from ..chain.bls_pool import BlsBatchPool
-from ..chain.clock import LocalClock
+from ..chain.clock import LocalClock, ManualClock
 from ..config.chain_config import ChainConfig
 from ..crypto.bls.api import SecretKey, aggregate_signatures, interop_secret_key
 from ..params import (
@@ -58,7 +58,9 @@ class DevChain:
             i: interop_secret_key(i) for i in range(validator_count)
         }
         genesis = interop_genesis_state(preset, cfg, validator_count, genesis_time or 1)
-        self.clock = LocalClock(
+        # manual clock: the dev loop pins the slot as it advances, so
+        # clock-gated paths (proposer boost, gossip slot windows) behave
+        self.clock = ManualClock(
             genesis_time or 1, cfg.SECONDS_PER_SLOT, preset.SLOTS_PER_EPOCH
         )
         self.chain = BeaconChain(preset, cfg, genesis, bls_pool, db=db, metrics=metrics, clock=self.clock)
@@ -155,6 +157,7 @@ class DevChain:
     async def advance_slot(self, slot: int, with_attestations: bool = True) -> bytes:
         """Produce + import the block for `slot`; then attest on the new
         head for inclusion at slot+1."""
+        self.clock.set_slot(slot)
         atts = [
             a
             for a in self.pending_attestations
@@ -185,6 +188,7 @@ class DevChain:
         """Produce, sign, import and RETURN the signed block for `slot`
         (no attestation flow) — the building block for network tests and
         external publishers."""
+        self.clock.set_slot(slot)
         head_state = self.chain.head_state()
         pre = clone_state(self.p, head_state)
         ctx = process_slots(self.p, self.cfg, pre, slot)

@@ -1,0 +1,149 @@
+"""ValidatorClient: duty polling + production over the REST API.
+
+Reference: packages/validator/src/validator.ts:60 (orchestrator),
+services/block.ts (produce->sign->publish), services/attestation.ts:22
+(duties->attestation_data->sign->submit).  The client is clock-agnostic:
+`run_slot(slot)` performs the duties for one slot so tests (and a real
+timer loop) drive it explicitly.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..api.client import ApiClient
+from ..api.serde import from_json, to_json
+from ..config.chain_config import ChainConfig
+from ..params import Preset
+from ..ssz import Fields
+from ..state_transition import compute_epoch_at_slot
+from ..utils.logger import get_logger
+from .store import ValidatorStore
+
+logger = get_logger("validator")
+
+
+class ValidatorClient:
+    def __init__(self, preset: Preset, cfg: ChainConfig, store: ValidatorStore, api: ApiClient):
+        self.p = preset
+        self.cfg = cfg
+        self.store = store
+        self.api = api
+        self._attester_duties: Dict[int, List[dict]] = {}  # epoch -> duties
+        self._proposer_duties: Dict[int, List[dict]] = {}
+
+    # -- duties (services/attestationDuties.ts / blockDuties.ts) --------------
+
+    async def poll_duties(self, epoch: int) -> None:
+        indices = [str(i) for i in self.store.keys]
+        att = await self.api.post(f"/eth/v1/validator/duties/attester/{epoch}", indices)
+        self._attester_duties[epoch] = att["data"]
+        prop = await self.api.get(f"/eth/v1/validator/duties/proposer/{epoch}")
+        ours = {str(i) for i in self.store.keys}
+        self._proposer_duties[epoch] = [
+            d for d in prop["data"] if d["validator_index"] in ours
+        ]
+
+    # -- block production ------------------------------------------------------
+
+    async def propose_if_due(self, slot: int) -> Optional[bytes]:
+        epoch = compute_epoch_at_slot(self.p, slot)
+        if epoch not in self._proposer_duties:
+            await self.poll_duties(epoch)
+        duty = next(
+            (d for d in self._proposer_duties[epoch] if int(d["slot"]) == slot), None
+        )
+        if duty is None:
+            return None
+        vi = int(duty["validator_index"])
+        randao = self.store.sign_randao(vi, epoch)
+        resp = await self.api.get(
+            f"/eth/v2/validator/blocks/{slot}?randao_reveal=0x{randao.hex()}"
+        )
+        block = from_json(resp["data"])
+        sig = self.store.sign_block(vi, block)
+        out = await self.api.post(
+            "/eth/v1/beacon/blocks", to_json(Fields(message=block, signature=sig))
+        )
+        root = bytes.fromhex(out["data"]["root"][2:])
+        logger.info("proposed block at slot %d: %s", slot, root.hex()[:12])
+        return root
+
+    # -- attestations ----------------------------------------------------------
+
+    async def attest(self, slot: int) -> int:
+        epoch = compute_epoch_at_slot(self.p, slot)
+        if epoch not in self._attester_duties:
+            await self.poll_duties(epoch)
+        duties = [d for d in self._attester_duties[epoch] if int(d["slot"]) == slot]
+        submitted = 0
+        by_committee: Dict[int, List[dict]] = {}
+        for d in duties:
+            by_committee.setdefault(int(d["committee_index"]), []).append(d)
+        for committee_index, ds in by_committee.items():
+            resp = await self.api.get(
+                f"/eth/v1/validator/attestation_data?slot={slot}&committee_index={committee_index}"
+            )
+            data = from_json(resp["data"])
+            atts = []
+            for d in ds:
+                vi = int(d["validator_index"])
+                sig = self.store.sign_attestation(vi, data)
+                bits = [False] * int(d["committee_length"])
+                bits[int(d["validator_committee_index"])] = True
+                atts.append(to_json(Fields(aggregation_bits=bits, data=data, signature=sig)))
+            await self.api.post("/eth/v1/beacon/pool/attestations", atts)
+            submitted += len(atts)
+        return submitted
+
+    # -- aggregation (services/attestation.ts aggregation phase) ---------------
+
+    async def aggregate(self, slot: int) -> int:
+        """2/3-slot duty: for each committee where one of our validators is
+        an aggregator, fetch the pool aggregate and publish a signed
+        AggregateAndProof."""
+        from ..chain.validation import is_aggregator
+        from ..types import get_types
+
+        t = get_types(self.p).phase0
+        epoch = compute_epoch_at_slot(self.p, slot)
+        duties = [d for d in self._attester_duties.get(epoch, []) if int(d["slot"]) == slot]
+        submitted = 0
+        done_committees = set()
+        for d in duties:
+            committee_index = int(d["committee_index"])
+            if committee_index in done_committees:
+                continue
+            vi = int(d["validator_index"])
+            proof = self.store.sign_selection_proof(vi, slot)
+            if not is_aggregator(self.p, int(d["committee_length"]), proof):
+                continue
+            done_committees.add(committee_index)
+            resp = await self.api.get(
+                f"/eth/v1/validator/attestation_data?slot={slot}&committee_index={committee_index}"
+            )
+            data = from_json(resp["data"])
+            data_root = t.AttestationData.hash_tree_root(data)
+            try:
+                agg_resp = await self.api.get(
+                    f"/eth/v1/validator/aggregate_attestation?slot={slot}"
+                    f"&attestation_data_root=0x{data_root.hex()}"
+                )
+            except Exception:
+                continue  # nothing in the pool for this committee
+            aggregate = from_json(agg_resp["data"])
+            anp = Fields(
+                aggregator_index=vi, aggregate=aggregate, selection_proof=proof
+            )
+            sig = self.store.sign_aggregate_and_proof(vi, anp)
+            await self.api.post(
+                "/eth/v1/validator/aggregate_and_proofs",
+                [to_json(Fields(message=anp, signature=sig))],
+            )
+            submitted += 1
+        return submitted
+
+    async def run_slot(self, slot: int) -> None:
+        await self.propose_if_due(slot)
+        await self.attest(slot)
+        await self.aggregate(slot)

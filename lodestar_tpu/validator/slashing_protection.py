@@ -1,0 +1,120 @@
+"""Slashing protection: double-vote, surround-vote, and double-proposal
+guards with the EIP-3076 interchange format.
+
+Reference: packages/validator/src/slashingProtection/ (index.ts:30;
+attestation/ with MinMaxSurround, block/ with proposal uniqueness;
+interchange/ for the JSON format).  Model: the min-max-surround espresso
+scheme reduced to its observable contract — per validator we keep every
+signed (source, target) pair and signed proposal slot, and refuse to sign
+anything that is a double vote, surrounds/is surrounded by a prior vote,
+or repeats a proposal slot with a different root.  The full interchange
+round-trips through `export_interchange` / `import_interchange`.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+
+class SlashingError(Exception):
+    pass
+
+
+class SlashingProtection:
+    def __init__(self, genesis_validators_root: bytes = b"\x00" * 32):
+        self.genesis_validators_root = genesis_validators_root
+        # pubkey -> list of (source_epoch, target_epoch, signing_root)
+        self._attestations: Dict[bytes, List[Tuple[int, int, bytes]]] = {}
+        # pubkey -> {slot: signing_root}
+        self._proposals: Dict[bytes, Dict[int, bytes]] = {}
+
+    # -- attestations ----------------------------------------------------------
+
+    def check_and_insert_attestation(
+        self, pubkey: bytes, source_epoch: int, target_epoch: int, signing_root: bytes
+    ) -> None:
+        """Raises SlashingError if signing would be slashable; records the
+        attestation otherwise.  Mirrors checkAndInsertAttestation
+        (slashingProtection/index.ts:52)."""
+        if source_epoch > target_epoch:
+            raise SlashingError("source after target")
+        hist = self._attestations.setdefault(pubkey, [])
+        for s, t, root in hist:
+            if t == target_epoch and root != signing_root:
+                raise SlashingError(f"double vote at target {target_epoch}")
+            if t == target_epoch and root == signing_root:
+                return  # identical re-sign is safe
+            # new surrounds old
+            if source_epoch < s and target_epoch > t:
+                raise SlashingError(f"surrounds prior vote ({s}->{t})")
+            # old surrounds new
+            if s < source_epoch and t > target_epoch:
+                raise SlashingError(f"surrounded by prior vote ({s}->{t})")
+        hist.append((source_epoch, target_epoch, signing_root))
+
+    # -- proposals -------------------------------------------------------------
+
+    def check_and_insert_block_proposal(
+        self, pubkey: bytes, slot: int, signing_root: bytes
+    ) -> None:
+        """Raises SlashingError on a conflicting proposal at `slot`
+        (checkAndInsertBlockProposal, block/index.ts)."""
+        props = self._proposals.setdefault(pubkey, {})
+        prior = props.get(slot)
+        if prior is not None and prior != signing_root:
+            raise SlashingError(f"double proposal at slot {slot}")
+        props[slot] = signing_root
+
+    # -- EIP-3076 interchange --------------------------------------------------
+
+    def export_interchange(self) -> dict:
+        data = []
+        pubkeys = set(self._attestations) | set(self._proposals)
+        for pk in sorted(pubkeys):
+            data.append(
+                {
+                    "pubkey": "0x" + pk.hex(),
+                    "signed_blocks": [
+                        {"slot": str(slot), "signing_root": "0x" + root.hex()}
+                        for slot, root in sorted(self._proposals.get(pk, {}).items())
+                    ],
+                    "signed_attestations": [
+                        {
+                            "source_epoch": str(s),
+                            "target_epoch": str(t),
+                            "signing_root": "0x" + root.hex(),
+                        }
+                        for s, t, root in self._attestations.get(pk, [])
+                    ],
+                }
+            )
+        return {
+            "metadata": {
+                "interchange_format_version": "5",
+                "genesis_validators_root": "0x" + self.genesis_validators_root.hex(),
+            },
+            "data": data,
+        }
+
+    def import_interchange(self, interchange: dict) -> None:
+        meta = interchange.get("metadata", {})
+        gvr = meta.get("genesis_validators_root")
+        if gvr and bytes.fromhex(gvr[2:]) != self.genesis_validators_root:
+            raise SlashingError("interchange genesis_validators_root mismatch")
+        for entry in interchange.get("data", []):
+            pk = bytes.fromhex(entry["pubkey"][2:])
+            for blk in entry.get("signed_blocks", []):
+                root = bytes.fromhex(blk.get("signing_root", "0x" + "00" * 32)[2:])
+                self._proposals.setdefault(pk, {})[int(blk["slot"])] = root
+            for att in entry.get("signed_attestations", []):
+                root = bytes.fromhex(att.get("signing_root", "0x" + "00" * 32)[2:])
+                self._attestations.setdefault(pk, []).append(
+                    (int(att["source_epoch"]), int(att["target_epoch"]), root)
+                )
+
+    def export_json(self) -> str:
+        return json.dumps(self.export_interchange(), indent=2)
+
+    def import_json(self, raw: str) -> None:
+        self.import_interchange(json.loads(raw))

@@ -1,0 +1,105 @@
+"""ValidatorStore: keys + every signing path, gated by slashing protection.
+
+Reference: packages/validator/src/services/validatorStore.ts (signBlock,
+signAttestation, signAggregateAndProof, signRandao, signVoluntaryExit) —
+every signature a VC can make flows through this object so the slashing
+protection gate is unbypassable.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..config.chain_config import ChainConfig
+from ..crypto.bls.api import SecretKey
+from ..params import (
+    DOMAIN_AGGREGATE_AND_PROOF,
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_RANDAO,
+    DOMAIN_SELECTION_PROOF,
+    DOMAIN_VOLUNTARY_EXIT,
+    Preset,
+)
+from ..ssz import Fields, uint64
+from ..state_transition import (
+    compute_domain,
+    compute_epoch_at_slot,
+    compute_signing_root,
+)
+from ..types import get_types
+from .slashing_protection import SlashingProtection
+
+
+class ValidatorStore:
+    def __init__(
+        self,
+        preset: Preset,
+        cfg: ChainConfig,
+        keys: Dict[int, SecretKey],
+        slashing_protection: Optional[SlashingProtection] = None,
+        genesis_validators_root: bytes = b"\x00" * 32,
+    ):
+        self.p = preset
+        self.cfg = cfg
+        self.keys = keys
+        self.t = get_types(preset).phase0
+        self.gvr = genesis_validators_root
+        self.protection = slashing_protection or SlashingProtection(genesis_validators_root)
+        self.pubkeys = {i: sk.to_public_key().to_bytes() for i, sk in keys.items()}
+
+    def _domain(self, domain_type: bytes, epoch: int) -> bytes:
+        from ..config.fork_config import ForkConfig
+
+        fork_version = ForkConfig(self.cfg).get_fork_info_at_epoch(epoch).version
+        return compute_domain(self.p, domain_type, fork_version, self.gvr)
+
+    # -- signing paths ---------------------------------------------------------
+
+    def sign_randao(self, validator_index: int, epoch: int) -> bytes:
+        domain = self._domain(DOMAIN_RANDAO, epoch)
+        root = compute_signing_root(self.p, uint64, epoch, domain)
+        return self.keys[validator_index].sign(root).to_bytes()
+
+    def sign_block(self, validator_index: int, block) -> bytes:
+        from ..state_transition.upgrade import block_types
+
+        epoch = compute_epoch_at_slot(self.p, block.slot)
+        domain = self._domain(DOMAIN_BEACON_PROPOSER, epoch)
+        root = compute_signing_root(
+            self.p, block_types(self.p, block).BeaconBlock, block, domain
+        )
+        pk = self.pubkeys[validator_index]
+        self.protection.check_and_insert_block_proposal(pk, block.slot, root)
+        return self.keys[validator_index].sign(root).to_bytes()
+
+    def sign_attestation(self, validator_index: int, data) -> bytes:
+        domain = self._domain(DOMAIN_BEACON_ATTESTER, data.target.epoch)
+        root = compute_signing_root(self.p, self.t.AttestationData, data, domain)
+        pk = self.pubkeys[validator_index]
+        self.protection.check_and_insert_attestation(
+            pk, data.source.epoch, data.target.epoch, root
+        )
+        return self.keys[validator_index].sign(root).to_bytes()
+
+    def sign_selection_proof(self, validator_index: int, slot: int) -> bytes:
+        epoch = compute_epoch_at_slot(self.p, slot)
+        domain = self._domain(DOMAIN_SELECTION_PROOF, epoch)
+        root = compute_signing_root(self.p, uint64, slot, domain)
+        return self.keys[validator_index].sign(root).to_bytes()
+
+    def sign_aggregate_and_proof(self, validator_index: int, aggregate_and_proof) -> bytes:
+        epoch = compute_epoch_at_slot(self.p, aggregate_and_proof.aggregate.data.slot)
+        domain = self._domain(DOMAIN_AGGREGATE_AND_PROOF, epoch)
+        root = compute_signing_root(
+            self.p, self.t.AggregateAndProof, aggregate_and_proof, domain
+        )
+        return self.keys[validator_index].sign(root).to_bytes()
+
+    def sign_voluntary_exit(self, validator_index: int, exit_epoch: int) -> Fields:
+        msg = Fields(epoch=exit_epoch, validator_index=validator_index)
+        domain = self._domain(DOMAIN_VOLUNTARY_EXIT, exit_epoch)
+        root = compute_signing_root(self.p, self.t.VoluntaryExit, msg, domain)
+        return Fields(
+            message=msg, signature=self.keys[validator_index].sign(root).to_bytes()
+        )

@@ -1,0 +1,133 @@
+"""REST API + validator client integration.
+
+VERDICT r2 #8 done-criteria: a VC process drives chain duties over HTTP
+for an epoch; a double-vote attempt is refused by slashing protection.
+Reference precedent: packages/validator e2e tests + api/impl/validator.
+"""
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.api import ApiClient, RestApiServer
+from lodestar_tpu.chain.bls_pool import BlsBatchPool
+from lodestar_tpu.chain.handlers import GossipHandlers
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.crypto.bls.api import interop_secret_key
+from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.metrics.registry import MetricsRegistry
+from lodestar_tpu.node.dev_chain import DevChain
+from lodestar_tpu.params import MINIMAL
+from lodestar_tpu.ssz import Fields
+from lodestar_tpu.validator import (
+    SlashingError,
+    SlashingProtection,
+    ValidatorClient,
+    ValidatorStore,
+)
+
+CFG = ChainConfig(
+    PRESET_BASE="minimal", SHARD_COMMITTEE_PERIOD=0, MIN_GENESIS_TIME=0,
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
+    ALTAIR_FORK_EPOCH=2**64 - 1, BELLATRIX_FORK_EPOCH=2**64 - 1,
+)
+N = 16
+
+
+def test_vc_drives_chain_over_http():
+    async def main():
+        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        dev = DevChain(MINIMAL, CFG, N, pool)
+        metrics = MetricsRegistry()
+        server = RestApiServer(MINIMAL, dev.chain, metrics_registry=None)
+        server.gossip_handlers = GossipHandlers(dev.chain)
+        port = await server.listen(0)
+        api = ApiClient("127.0.0.1", port)
+
+        # node endpoints
+        assert (await api.get("/eth/v1/node/version"))["data"]["version"]
+        syncing = await api.get("/eth/v1/node/syncing")
+        assert syncing["data"]["head_slot"] == "0"
+        genesis = await api.get("/eth/v1/beacon/genesis")
+        assert genesis["data"]["genesis_validators_root"].startswith("0x")
+
+        # VC with all interop keys drives one epoch of duties over HTTP
+        keys = {i: interop_secret_key(i) for i in range(N)}
+        gvr = bytes(dev.chain.genesis_state.genesis_validators_root)
+        store = ValidatorStore(MINIMAL, CFG, keys, genesis_validators_root=gvr)
+        vc = ValidatorClient(MINIMAL, CFG, store, api)
+
+        for slot in range(1, MINIMAL.SLOTS_PER_EPOCH + 1):
+            dev.clock.set_slot(slot)  # the node's wall clock follows slots
+            await vc.run_slot(slot)
+
+        head = dev.chain.head_state()
+        assert head.slot == MINIMAL.SLOTS_PER_EPOCH, "VC failed to drive a full epoch"
+        # attestations flowed through the pool API into blocks
+        head_block = dev.chain.get_block_by_root(dev.chain.head_root)
+        assert len(head_block.message.body.attestations) > 0
+
+        # finality checkpoints endpoint reflects progress
+        fc = await api.get("/eth/v1/beacon/states/head/finality_checkpoints")
+        assert "current_justified" in fc["data"]
+
+        # validator endpoint
+        v0 = await api.get("/eth/v1/beacon/states/head/validators/0")
+        assert v0["data"]["index"] == "0"
+
+        await server.close()
+        pool.close()
+
+    asyncio.run(main())
+
+
+def test_slashing_protection_blocks_double_signs():
+    sp = SlashingProtection()
+    pk = b"\x11" * 48
+
+    # attestation double vote: same target, different root
+    sp.check_and_insert_attestation(pk, 0, 1, b"\xaa" * 32)
+    with pytest.raises(SlashingError):
+        sp.check_and_insert_attestation(pk, 0, 1, b"\xbb" * 32)
+    # identical re-sign is fine
+    sp.check_and_insert_attestation(pk, 0, 1, b"\xaa" * 32)
+
+    # surround: prior (2->5); new (1->6) surrounds, new (3->4) surrounded
+    sp.check_and_insert_attestation(pk, 2, 5, b"\xcc" * 32)
+    with pytest.raises(SlashingError):
+        sp.check_and_insert_attestation(pk, 1, 6, b"\xdd" * 32)
+    with pytest.raises(SlashingError):
+        sp.check_and_insert_attestation(pk, 3, 4, b"\xee" * 32)
+
+    # proposal double sign
+    sp.check_and_insert_block_proposal(pk, 9, b"\x01" * 32)
+    with pytest.raises(SlashingError):
+        sp.check_and_insert_block_proposal(pk, 9, b"\x02" * 32)
+    sp.check_and_insert_block_proposal(pk, 9, b"\x01" * 32)  # same root ok
+
+    # EIP-3076 interchange round-trip preserves protection
+    raw = sp.export_json()
+    sp2 = SlashingProtection()
+    sp2.import_json(raw)
+    with pytest.raises(SlashingError):
+        sp2.check_and_insert_attestation(pk, 0, 1, b"\xbb" * 32)
+    with pytest.raises(SlashingError):
+        sp2.check_and_insert_block_proposal(pk, 9, b"\x02" * 32)
+
+
+def test_vc_store_refuses_double_vote_via_signing_path():
+    keys = {0: interop_secret_key(0)}
+    store = ValidatorStore(MINIMAL, CFG, keys)
+    data1 = Fields(
+        slot=8, index=0, beacon_block_root=b"\x01" * 32,
+        source=Fields(epoch=0, root=b"\x00" * 32),
+        target=Fields(epoch=1, root=b"\x02" * 32),
+    )
+    data2 = Fields(
+        slot=8, index=0, beacon_block_root=b"\x03" * 32,  # conflicting vote
+        source=Fields(epoch=0, root=b"\x00" * 32),
+        target=Fields(epoch=1, root=b"\x04" * 32),
+    )
+    store.sign_attestation(0, data1)
+    with pytest.raises(SlashingError):
+        store.sign_attestation(0, data2)
