@@ -82,6 +82,7 @@ def test_gossip_block_propagation_and_unknown_parent():
         # A produces a block for slot 1 and publishes it; B imports via the
         # gossip handler path
         signed = await a.produce_and_import_block(1)
+        b.clock.set_slot(1)  # B's wall clock follows the net's slot
         n_sent = await net_a.publish_block(signed)
         assert n_sent == 1
         for _ in range(100):  # poll: import includes STF + batch verify
@@ -94,6 +95,7 @@ def test_gossip_block_propagation_and_unknown_parent():
         # B resolves ancestors via blocks_by_root (unknown-block sync)
         s2 = await a.produce_and_import_block(2)
         s3 = await a.produce_and_import_block(3)
+        b.clock.set_slot(3)
         # B hasn't seen s2; hand s3 to the resolver directly (the gossip
         # path would surface BlockError: unknown parent first)
         ub = UnknownBlockSync(MINIMAL, b.chain, net_b.peer_manager)
