@@ -1,0 +1,128 @@
+"""Sync-committee pools + gossip validators (altair).
+
+Reference flows: chain/validation/syncCommittee.ts,
+opPools/syncCommitteeMessagePool.ts, syncContributionAndProofPool.ts.
+"""
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain.bls_pool import BlsBatchPool
+from lodestar_tpu.chain.seen_cache import SeenSyncCommitteeMessages
+from lodestar_tpu.chain.sync_committee_pools import (
+    SyncCommitteeMessagePool,
+    SyncContributionAndProofPool,
+    is_sync_committee_aggregator,
+    subcommittee_assignment,
+    validate_sync_committee_message,
+)
+from lodestar_tpu.chain.validation import GossipValidationError
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.crypto.bls.api import interop_secret_key
+from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.node.dev_chain import DevChain
+from lodestar_tpu.params import DOMAIN_SYNC_COMMITTEE, MINIMAL
+from lodestar_tpu.params.presets import SYNC_COMMITTEE_SUBNET_COUNT
+from lodestar_tpu.ssz import Fields
+from lodestar_tpu.state_transition import (
+    EpochContext,
+    compute_epoch_at_slot,
+    get_domain,
+)
+from lodestar_tpu.types import get_types
+
+# altair from genesis
+CFG = ChainConfig(
+    PRESET_BASE="minimal", SHARD_COMMITTEE_PERIOD=0, MIN_GENESIS_TIME=0,
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
+    ALTAIR_FORK_EPOCH=1, BELLATRIX_FORK_EPOCH=2**64 - 1,
+)
+N = 16
+T = get_types(MINIMAL).phase0
+
+
+def make_message(dev, state, vi: int, slot: int, block_root: bytes):
+    epoch = compute_epoch_at_slot(dev.p, slot)
+    domain = get_domain(dev.p, state, DOMAIN_SYNC_COMMITTEE, epoch)
+    signing_root = T.SigningData.hash_tree_root(
+        Fields(object_root=block_root, domain=domain)
+    )
+    return Fields(
+        slot=slot,
+        beacon_block_root=block_root,
+        validator_index=vi,
+        signature=dev.keys[vi].sign(signing_root).to_bytes(),
+    )
+
+
+def test_sync_message_validation_and_pools():
+    async def main():
+        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        dev = DevChain(MINIMAL, CFG, N, pool)
+        await dev.run(MINIMAL.SLOTS_PER_EPOCH + 2, with_attestations=False)
+        chain = dev.chain
+        state = chain.head_state()
+        ctx = EpochContext.create_from_state(MINIMAL, state)
+        head_root = chain.head_root
+        slot = state.slot
+        seen = SeenSyncCommitteeMessages()
+
+        # find a validator in the current sync committee and its subnet
+        vi, subnet = None, None
+        for i in range(N):
+            subs = subcommittee_assignment(MINIMAL, state, i)
+            if subs:
+                vi, subnet = i, subs[0]
+                break
+        assert vi is not None, "no interop validator in the sync committee?"
+
+        msg = make_message(dev, state, vi, slot, head_root)
+        idx = await validate_sync_committee_message(
+            MINIMAL, CFG, message=msg, subnet=subnet, clock_slot=slot,
+            state=state, ctx=ctx, seen_sync_msgs=seen, pool=pool,
+        )
+        # pool the message, build a contribution, feed the contribution pool
+        msg_pool = chain.sync_msg_pool
+        msg_pool.add(slot, head_root, subnet, idx, bytes(msg.signature))
+        contribution = msg_pool.get_contribution(slot, head_root, subnet)
+        assert contribution is not None
+        assert sum(contribution.aggregation_bits) == 1
+        chain.contribution_pool.add(contribution)
+        agg = chain.contribution_pool.get_sync_aggregate(slot, head_root)
+        assert any(agg.sync_committee_bits)
+
+        # duplicate is IGNOREd
+        with pytest.raises(GossipValidationError):
+            await validate_sync_committee_message(
+                MINIMAL, CFG, message=msg, subnet=subnet, clock_slot=slot,
+                state=state, ctx=ctx, seen_sync_msgs=seen, pool=pool,
+            )
+        # wrong subnet is REJECTed
+        bad_subnet = (subnet + 1) % SYNC_COMMITTEE_SUBNET_COUNT
+        msg2 = make_message(dev, state, vi, slot, head_root)
+        if bad_subnet not in subcommittee_assignment(MINIMAL, state, vi):
+            with pytest.raises(GossipValidationError):
+                await validate_sync_committee_message(
+                    MINIMAL, CFG, message=msg2, subnet=bad_subnet, clock_slot=slot,
+                    state=state, ctx=ctx, seen_sync_msgs=SeenSyncCommitteeMessages(),
+                    pool=pool,
+                )
+        # bad signature is REJECTed
+        msg3 = make_message(dev, state, vi, slot, head_root)
+        msg3.signature = dev.keys[(vi + 1) % N].sign(b"\x00" * 32).to_bytes()
+        with pytest.raises(GossipValidationError):
+            await validate_sync_committee_message(
+                MINIMAL, CFG, message=msg3, subnet=subnet, clock_slot=slot,
+                state=state, ctx=ctx, seen_sync_msgs=SeenSyncCommitteeMessages(),
+                pool=pool,
+            )
+        pool.close()
+
+    asyncio.run(main())
+
+
+def test_aggregator_selection_is_deterministic():
+    a = is_sync_committee_aggregator(MINIMAL, b"\x01" * 96)
+    b = is_sync_committee_aggregator(MINIMAL, b"\x01" * 96)
+    assert a == b
