@@ -1,0 +1,147 @@
+"""Light-client server: bootstrap + best-update production per sync period.
+
+Reference: packages/beacon-node/src/chain/lightClient/index.ts:151
+(LightClientServer: onImportBlockHead tracks attested/finalized data and
+keeps the best LightClientUpdate per sync-committee period, served over
+the API; getBootstrap serves header + current committee + proof).
+
+Shape here: the server subscribes to block imports; every altair block
+whose sync_aggregate attests its parent yields a candidate update for the
+parent's period, scored by participation (isBetterUpdate reduced to the
+participation ordering, which dominates in practice).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..params import Preset
+from ..ssz import Fields
+from ..state_transition import compute_epoch_at_slot
+from ..types import get_types
+from ..utils.logger import get_logger
+
+logger = get_logger("light-client-server")
+
+
+def sync_period_at_slot(p: Preset, slot: int) -> int:
+    return compute_epoch_at_slot(p, slot) // p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+
+
+def block_to_header(p: Preset, block, body_root: Optional[bytes] = None) -> Fields:
+    from ..state_transition.upgrade import block_types
+
+    t = block_types(p, block)
+    return Fields(
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=bytes(block.parent_root),
+        state_root=bytes(block.state_root),
+        body_root=body_root or t.BeaconBlockBody.hash_tree_root(block.body),
+    )
+
+
+class LightClientServer:
+    def __init__(self, preset: Preset, chain):
+        self.p = preset
+        self.chain = chain
+        self.t = get_types(preset)
+        self.best_update_by_period: Dict[int, object] = {}
+        chain.emitter.on_block(self._on_block) if hasattr(chain.emitter, "on_block") else None
+        from .emitter import ChainEvent
+
+        chain.emitter.on(ChainEvent.BLOCK, self._on_block)
+
+    # -- bootstrap (getBootstrap) ---------------------------------------------
+
+    def get_bootstrap(self, block_root: bytes):
+        """Header + current sync committee + proof for a trusted root."""
+        from ..state_transition.upgrade import state_types
+
+        block = self.chain.get_block_by_root(block_root)
+        state = self.chain.get_state_by_block_root(block_root)
+        if block is None or state is None:
+            return None
+        st = state_types(self.p, state).BeaconState
+        committee_root, branch = st.get_field_proof(state, "current_sync_committee")
+        return Fields(
+            header=block_to_header(self.p, block.message),
+            current_sync_committee=state.current_sync_committee,
+            current_sync_committee_branch=[bytes(b) for b in branch],
+        )
+
+    # -- update production (onImportBlock) ------------------------------------
+
+    def _on_block(self, signed_block, block_root: bytes) -> None:
+        block = signed_block.message
+        body = block.body
+        if "sync_aggregate" not in body.keys():
+            return
+        agg = body.sync_aggregate
+        participation = sum(agg.sync_committee_bits)
+        if participation == 0:
+            return
+        attested_root = bytes(block.parent_root)
+        attested_block = self.chain.get_block_by_root(attested_root)
+        attested_state = self.chain.get_state_by_block_root(attested_root)
+        if attested_block is None or attested_state is None:
+            return
+        period = sync_period_at_slot(self.p, attested_block.message.slot)
+        cur = self.best_update_by_period.get(period)
+        if cur is not None:
+            cur_part = sum(cur.sync_aggregate.sync_committee_bits)
+            # isBetterUpdate: more participation wins; on a tie prefer the
+            # newer attested header (fresher finality info)
+            if cur_part > participation or (
+                cur_part == participation
+                and cur.attested_header.slot >= attested_block.message.slot
+            ):
+                return
+        update = self._build_update(attested_block, attested_state, agg)
+        if update is not None:
+            self.best_update_by_period[period] = update
+
+    def _build_update(self, attested_block, attested_state, sync_aggregate):
+        from ..state_transition.upgrade import state_types
+
+        st = state_types(self.p, attested_state).BeaconState
+        try:
+            _, nsc_branch = st.get_field_proof(attested_state, "next_sync_committee")
+        except StopIteration:
+            return None  # pre-altair attested state: no update possible
+        fin_cp = attested_state.finalized_checkpoint
+        finalized_header = None
+        if bytes(fin_cp.root) != b"\x00" * 32:
+            fin_block = self.chain.get_block_by_root(bytes(fin_cp.root))
+            if fin_block is not None:
+                finalized_header = block_to_header(self.p, fin_block.message)
+        # finality branch: checkpoint root within Checkpoint (epoch sibling)
+        # then finalized_checkpoint within the state
+        _, state_branch = st.get_field_proof(attested_state, "finalized_checkpoint")
+        t0 = self.t.phase0
+        epoch_leaf = t0.Epoch.hash_tree_root(fin_cp.epoch) if hasattr(t0, "Epoch") else None
+        from ..ssz import uint64 as u64t
+
+        epoch_leaf = u64t.hash_tree_root(fin_cp.epoch)
+        finality_branch = [epoch_leaf] + [bytes(b) for b in state_branch]
+        empty_header = Fields(
+            slot=0, proposer_index=0, parent_root=b"\x00" * 32,
+            state_root=b"\x00" * 32, body_root=b"\x00" * 32,
+        )
+        return Fields(
+            attested_header=block_to_header(self.p, attested_block.message),
+            next_sync_committee=attested_state.next_sync_committee,
+            next_sync_committee_branch=[bytes(b) for b in nsc_branch],
+            finalized_header=finalized_header or empty_header,
+            finality_branch=finality_branch,
+            sync_aggregate=sync_aggregate,
+            fork_version=bytes(attested_state.fork.current_version),
+        )
+
+    def get_update(self, period: int):
+        return self.best_update_by_period.get(period)
+
+    def get_latest_update(self):
+        if not self.best_update_by_period:
+            return None
+        return self.best_update_by_period[max(self.best_update_by_period)]

@@ -1,0 +1,145 @@
+"""LightClient: header tracking via validated sync-committee updates.
+
+Reference: packages/light-client/src/index.ts:110 with the altair sync
+protocol semantics: an update is valid when (1) its sync aggregate has
+enough participation, (2) the aggregate signature by the KNOWN sync
+committee verifies over the attested header, (3) the merkle branches tie
+the next sync committee and finalized header into the attested state
+root.  Applying a finalized update advances the store's finalized header
+and rotates committees across periods.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+from ..config.chain_config import ChainConfig
+from ..params import DOMAIN_SYNC_COMMITTEE, Preset
+from ..ssz import Fields
+from ..state_transition import compute_domain, compute_epoch_at_slot
+from ..types import get_types
+from ..utils.logger import get_logger
+
+logger = get_logger("light-client")
+
+
+class LightClientError(Exception):
+    pass
+
+
+def _verify_branch(leaf: bytes, branch, index_in_container: int, root: bytes) -> bool:
+    """is_valid_merkle_branch over a bottom-up sibling list for a field at
+    position `index_in_container` of the (padded) container tree; for the
+    finality branch the caller pre-composes the deeper path."""
+    h = leaf
+    idx = index_in_container
+    for sib in branch:
+        if idx & 1:
+            h = hashlib.sha256(bytes(sib) + h).digest()
+        else:
+            h = hashlib.sha256(h + bytes(sib)).digest()
+        idx //= 2
+    return h == root
+
+
+class LightClient:
+    def __init__(self, preset: Preset, cfg: ChainConfig, bootstrap,
+                 genesis_validators_root: bytes):
+        self.p = preset
+        self.cfg = cfg
+        self.t = get_types(preset)
+        self.gvr = genesis_validators_root
+        self.finalized_header = bootstrap.header
+        self.optimistic_header = bootstrap.header
+        self.current_sync_committee = bootstrap.current_sync_committee
+        self.next_sync_committee = None
+        # verify the bootstrap proof against the trusted header state root
+        st_alt = self.t.altair
+        leaf = st_alt.SyncCommittee.hash_tree_root(bootstrap.current_sync_committee)
+        idx = self._field_index("current_sync_committee")
+        if not _verify_branch(
+            leaf, bootstrap.current_sync_committee_branch, idx,
+            bytes(bootstrap.header.state_root),
+        ):
+            raise LightClientError("invalid bootstrap sync committee proof")
+
+    def _field_index(self, name: str) -> int:
+        fields = [f for f, _ in self.t.altair.BeaconState.fields]
+        return fields.index(name)
+
+    # -- update processing (processLightClientUpdate) --------------------------
+
+    def process_update(self, update) -> None:
+        agg = update.sync_aggregate
+        participation = sum(agg.sync_committee_bits)
+        if participation * 3 < len(agg.sync_committee_bits) * 2:
+            raise LightClientError("insufficient sync committee participation")
+        attested = update.attested_header
+        state_root = bytes(attested.state_root)
+
+        # next sync committee proof
+        st_alt = self.t.altair
+        nsc_leaf = st_alt.SyncCommittee.hash_tree_root(update.next_sync_committee)
+        if not _verify_branch(
+            nsc_leaf, update.next_sync_committee_branch,
+            self._field_index("next_sync_committee"), state_root,
+        ):
+            raise LightClientError("invalid next_sync_committee branch")
+
+        # finality proof (when a finalized header is claimed)
+        finalized = update.finalized_header
+        if finalized.slot != 0 or bytes(finalized.state_root) != b"\x00" * 32:
+            fin_root = self.t.phase0.BeaconBlockHeader.hash_tree_root(finalized)
+            # path: root within Checkpoint (index 1), checkpoint in state
+            idx = 1 + 2 * self._field_index("finalized_checkpoint")
+            if not _verify_branch(fin_root, update.finality_branch, idx, state_root):
+                raise LightClientError("invalid finality branch")
+
+        # sync aggregate signature by the CURRENT committee over the
+        # attested header under DOMAIN_SYNC_COMMITTEE
+        from ..crypto.bls.api import PublicKey
+        from ..state_transition.altair import eth_fast_aggregate_verify
+
+        domain = compute_domain(
+            self.p, DOMAIN_SYNC_COMMITTEE, bytes(update.fork_version), self.gvr
+        )
+        signing_root = self.t.phase0.SigningData.hash_tree_root(
+            Fields(
+                object_root=self.t.phase0.BeaconBlockHeader.hash_tree_root(attested),
+                domain=domain,
+            )
+        )
+        pks = [
+            PublicKey.from_bytes(bytes(pk))
+            for pk, bit in zip(
+                self.current_sync_committee.pubkeys, agg.sync_committee_bits
+            )
+            if bit
+        ]
+        if not eth_fast_aggregate_verify(
+            pks, signing_root, bytes(agg.sync_committee_signature)
+        ):
+            raise LightClientError("invalid sync aggregate signature")
+
+        # apply
+        self.next_sync_committee = update.next_sync_committee
+        if attested.slot > self.optimistic_header.slot:
+            self.optimistic_header = attested
+        if finalized.slot > self.finalized_header.slot:
+            old_period = (
+                compute_epoch_at_slot(self.p, self.finalized_header.slot)
+                // self.p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+            )
+            new_period = (
+                compute_epoch_at_slot(self.p, finalized.slot)
+                // self.p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+            )
+            self.finalized_header = finalized
+            if new_period > old_period and self.next_sync_committee is not None:
+                # period rotation: the proven next committee becomes current
+                self.current_sync_committee = self.next_sync_committee
+        logger.info(
+            "light client advanced: optimistic slot %d, finalized slot %d",
+            self.optimistic_header.slot, self.finalized_header.slot,
+        )

@@ -511,6 +511,34 @@ class Container(SszType):
         roots = [ftype.hash_tree_root(value[fname]) for fname, ftype in self.fields]
         return merkleize(roots)
 
+    def get_field_proof(self, value, field_name: str):
+        """Merkle branch proving `field_name`'s subtree root against this
+        container's hash_tree_root.
+
+        Returns (field_root, branch) with branch bottom-up — the sibling
+        hashes along the path in the zero-padded power-of-two tree of field
+        roots (the light-client protocol's proof shape; spec
+        is_valid_merkle_branch consumes it as-is)."""
+        idx = next(i for i, (f, _) in enumerate(self.fields) if f == field_name)
+        roots = [ftype.hash_tree_root(value[fname]) for fname, ftype in self.fields]
+        n = 1
+        while n < len(roots):
+            n *= 2
+        layer = roots + [ZERO_HASHES[0]] * (n - len(roots))
+        field_root = roots[idx]
+        branch = []
+        pos = idx
+        depth = 0
+        while len(layer) > 1:
+            branch.append(layer[pos ^ 1])
+            nxt = []
+            for i in range(0, len(layer), 2):
+                nxt.append(hashlib.sha256(layer[i] + layer[i + 1]).digest())
+            layer = nxt
+            pos //= 2
+            depth += 1
+        return field_root, branch
+
     def default(self) -> Fields:
         return Fields(**{fname: ftype.default() for fname, ftype in self.fields})
 

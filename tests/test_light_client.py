@@ -1,0 +1,70 @@
+"""Light-client server + client end-to-end over an altair dev chain.
+
+Reference precedent: packages/light-client e2e (server produces updates on
+import; client bootstraps from a trusted root and follows finality).
+"""
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain.bls_pool import BlsBatchPool
+from lodestar_tpu.chain.light_client import LightClientServer, block_to_header
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.light_client import LightClient, LightClientError
+from lodestar_tpu.node.dev_chain import DevChain
+from lodestar_tpu.params import MINIMAL
+from lodestar_tpu.types import get_types
+
+CFG = ChainConfig(
+    PRESET_BASE="minimal", SHARD_COMMITTEE_PERIOD=0, MIN_GENESIS_TIME=0,
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
+    ALTAIR_FORK_EPOCH=1, BELLATRIX_FORK_EPOCH=2**64 - 1,
+)
+N = 16
+
+
+def test_light_client_follows_finality():
+    async def main():
+        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        dev = DevChain(MINIMAL, CFG, N, pool)
+        server = LightClientServer(MINIMAL, dev.chain)
+
+        # run past the altair fork, then long enough to finalize post-fork
+        await dev.run(5 * MINIMAL.SLOTS_PER_EPOCH + 2)
+
+        # bootstrap at a post-altair block (start of epoch 2)
+        chain = dev.chain
+        boot_root = chain.fork_choice.get_ancestor(
+            chain.head_root, MINIMAL.SLOTS_PER_EPOCH + 1
+        )
+        bootstrap = server.get_bootstrap(boot_root)
+        assert bootstrap is not None
+        gvr = bytes(chain.genesis_state.genesis_validators_root)
+        lc = LightClient(MINIMAL, CFG, bootstrap, gvr)
+
+        update = server.get_latest_update()
+        assert update is not None, "server produced no updates"
+        assert sum(update.sync_aggregate.sync_committee_bits) == MINIMAL.SYNC_COMMITTEE_SIZE
+
+        lc.process_update(update)
+        assert lc.optimistic_header.slot > bootstrap.header.slot
+        assert lc.finalized_header.slot > 0, "finality did not advance"
+
+        # tampered updates are rejected
+        bad = server.get_latest_update()
+        orig_bits = list(bad.sync_aggregate.sync_committee_bits)
+        bad.sync_aggregate.sync_committee_bits = [False] * len(orig_bits)
+        with pytest.raises(LightClientError):
+            lc.process_update(bad)
+        bad.sync_aggregate.sync_committee_bits = orig_bits
+        orig_root = bytes(bad.attested_header.state_root)
+        bad.attested_header.state_root = b"\x13" * 32
+        with pytest.raises(LightClientError):
+            lc.process_update(bad)
+        bad.attested_header.state_root = orig_root
+
+        pool.close()
+
+    asyncio.run(main())
