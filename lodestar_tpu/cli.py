@@ -1,0 +1,248 @@
+"""CLI: beacon / dev / validator / lightclient commands.
+
+Reference: packages/cli/src/cli.ts:20-47 (yargs command tree) and
+cmds/{beacon,dev,validator,lightclient}/.  argparse equivalent with the
+same command surface; options mirror the flag groups the reference
+exposes (network, api, metrics, db, interop validators).
+
+Entry: ``python -m lodestar_tpu.cli <cmd> [flags]``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+from typing import Optional
+
+from .config.chain_config import ChainConfig
+from .params import MAINNET, MINIMAL, Preset
+from .utils.logger import get_logger
+
+logger = get_logger("cli")
+
+
+def _preset(name: str) -> Preset:
+    return {"mainnet": MAINNET, "minimal": MINIMAL}[name]
+
+
+def _chain_config(args) -> ChainConfig:
+    kw = dict(
+        PRESET_BASE=args.preset,
+        MIN_GENESIS_TIME=0,
+        SHARD_COMMITTEE_PERIOD=0 if args.preset == "minimal" else 256,
+        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=args.validators or 16,
+    )
+    if args.altair_epoch is not None:
+        kw["ALTAIR_FORK_EPOCH"] = args.altair_epoch
+    if args.bellatrix_epoch is not None:
+        kw["BELLATRIX_FORK_EPOCH"] = args.bellatrix_epoch
+    return ChainConfig(**kw)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="lodestar-tpu", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def common(p):
+        p.add_argument("--preset", choices=["mainnet", "minimal"], default="minimal")
+        p.add_argument("--db", help="sqlite db path (default: in-memory)")
+        p.add_argument("--rest-port", type=int, default=9596)
+        p.add_argument("--metrics", action="store_true")
+        p.add_argument("--listen-port", type=int, default=9000)
+        p.add_argument("--connect", action="append", default=[],
+                       help="peer host:port to dial (repeatable)")
+        p.add_argument("--altair-epoch", type=int, default=None)
+        p.add_argument("--bellatrix-epoch", type=int, default=None)
+        p.add_argument("--validators", type=int, default=16)
+
+    dev = sub.add_parser("dev", help="single-process interop chain (cmds/dev)")
+    common(dev)
+    dev.add_argument("--slots", type=int, default=32, help="slots to run (0 = forever)")
+    dev.add_argument("--tpu-bls", action="store_true",
+                     help="verify signatures on the TPU batched kernel")
+
+    beacon = sub.add_parser("beacon", help="beacon node (cmds/beacon)")
+    common(beacon)
+    beacon.add_argument("--genesis-state", help="SSZ genesis state file")
+
+    vc = sub.add_parser("validator", help="validator client (cmds/validator)")
+    vc.add_argument("--beacon-url", default="http://127.0.0.1:9596")
+    vc.add_argument("--preset", choices=["mainnet", "minimal"], default="minimal")
+    vc.add_argument("--interop-indices", default="0..15",
+                    help="interop key range, e.g. 0..15")
+    vc.add_argument("--slashing-protection-db", help="EIP-3076 JSON path")
+
+    lc = sub.add_parser("lightclient", help="light client (cmds/lightclient)")
+    lc.add_argument("--beacon-url", default="http://127.0.0.1:9596")
+    lc.add_argument("--checkpoint-root", required=False)
+    lc.add_argument("--preset", choices=["mainnet", "minimal"], default="minimal")
+    return ap
+
+
+async def run_dev(args) -> int:
+    from .api import RestApiServer
+    from .chain.bls_pool import BlsBatchPool
+    from .chain.handlers import GossipHandlers
+    from .chain.light_client import LightClientServer
+    from .crypto.bls.verifier import PyBlsVerifier
+    from .db.beacon import BeaconDb
+    from .db.controller import MemoryDbController, SqliteDbController
+    from .metrics.registry import MetricsRegistry
+    from .network import Network
+    from .node.dev_chain import DevChain
+
+    preset = _preset(args.preset)
+    cfg = _chain_config(args)
+    if args.tpu_bls:
+        from .crypto.bls.tpu_verifier import TpuBlsVerifier
+
+        verifier = TpuBlsVerifier()
+    else:
+        verifier = PyBlsVerifier()
+    pool = BlsBatchPool(verifier)
+    controller = SqliteDbController(args.db) if args.db else MemoryDbController()
+    db = BeaconDb(preset, controller)
+    metrics = MetricsRegistry() if args.metrics else None
+    dev = DevChain(preset, cfg, args.validators, pool, db=db)
+    handlers = GossipHandlers(dev.chain)
+    LightClientServer(preset, dev.chain)
+    network = Network(preset, dev.chain, handlers)
+    await network.listen(args.listen_port)
+    for target in args.connect:
+        host, _, port = target.partition(":")
+        await network.connect(host, int(port))
+    rest = RestApiServer(preset, dev.chain, network=network, metrics_registry=metrics)
+    rest.gossip_handlers = handlers
+    await rest.listen(args.rest_port)
+    logger.info("dev chain: %d validators, %s preset", args.validators, args.preset)
+    n = args.slots if args.slots else 1 << 62
+    await dev.run(n)
+    state = dev.chain.head_state()
+    print(
+        json.dumps(
+            {
+                "head_slot": int(state.slot),
+                "justified_epoch": int(state.current_justified_checkpoint.epoch),
+                "finalized_epoch": int(state.finalized_checkpoint.epoch),
+            }
+        )
+    )
+    await network.close()
+    await rest.close()
+    pool.close()
+    return 0
+
+
+async def run_beacon(args) -> int:
+    """Boot a (non-producing) beacon node: db-resumed or genesis state,
+    network listener, REST API; follows peers via range sync + gossip.
+    Reference: cmds/beacon/handler.ts + initBeaconState.ts:104-136."""
+    from .api import RestApiServer
+    from .chain.beacon_chain import BeaconChain
+    from .chain.bls_pool import BlsBatchPool
+    from .chain.handlers import GossipHandlers
+    from .crypto.bls.verifier import PyBlsVerifier
+    from .db.beacon import BeaconDb
+    from .db.controller import MemoryDbController, SqliteDbController
+    from .network import Network
+    from .state_transition import interop_genesis_state
+    from .sync import RangeSync
+
+    preset = _preset(args.preset)
+    cfg = _chain_config(args)
+    controller = SqliteDbController(args.db) if args.db else MemoryDbController()
+    db = BeaconDb(preset, controller)
+    if args.genesis_state:
+        from .types import get_types
+
+        raw = open(args.genesis_state, "rb").read()
+        genesis = get_types(preset).phase0.BeaconState.deserialize(raw)
+    else:
+        resumed = db.last_archived_state()
+        genesis = resumed or interop_genesis_state(preset, cfg, args.validators, 1)
+    pool = BlsBatchPool(PyBlsVerifier())
+    chain = BeaconChain(preset, cfg, genesis, pool, db=db)
+    handlers = GossipHandlers(chain)
+    network = Network(preset, chain, handlers)
+    await network.listen(args.listen_port)
+    for target in args.connect:
+        host, _, port = target.partition(":")
+        peer = await network.connect(host, int(port))
+        logger.info("connected to %s (head slot %s)", target, peer.status.head_slot)
+    rest = RestApiServer(preset, chain, network=network)
+    rest.gossip_handlers = handlers
+    await rest.listen(args.rest_port)
+    sync = RangeSync(preset, chain, network.peer_manager)
+    imported = await sync.run_to_head()
+    logger.info("synced %d blocks; following gossip (ctrl-c to stop)", imported)
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    await network.close()
+    await rest.close()
+    pool.close()
+    return 0
+
+
+async def run_validator(args) -> int:
+    from .api.client import ApiClient
+    from .crypto.bls.api import interop_secret_key
+    from .validator import SlashingProtection, ValidatorClient, ValidatorStore
+
+    preset = _preset(args.preset)
+    cfg = ChainConfig(PRESET_BASE=args.preset, MIN_GENESIS_TIME=0,
+                      SHARD_COMMITTEE_PERIOD=0,
+                      MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16)
+    lo, _, hi = args.interop_indices.partition("..")
+    keys = {i: interop_secret_key(i) for i in range(int(lo), int(hi) + 1)}
+    url = args.beacon_url.rstrip("/")
+    host = url.split("//")[-1].split(":")[0]
+    port = int(url.rsplit(":", 1)[-1])
+    api = ApiClient(host, port)
+    protection = SlashingProtection()
+    if args.slashing_protection_db:
+        try:
+            protection.import_json(open(args.slashing_protection_db).read())
+        except FileNotFoundError:
+            pass
+    genesis = await api.get("/eth/v1/beacon/genesis")
+    gvr = bytes.fromhex(genesis["data"]["genesis_validators_root"][2:])
+    store = ValidatorStore(preset, cfg, keys, protection, genesis_validators_root=gvr)
+    vc = ValidatorClient(preset, cfg, store, api)
+    logger.info("validator client: %d keys against %s", len(keys), args.beacon_url)
+    slot = 1
+    try:
+        while True:
+            syncing = await api.get("/eth/v1/node/syncing")
+            head = int(syncing["data"]["head_slot"])
+            slot = max(slot, head + 1)
+            await vc.run_slot(slot)
+            slot += 1
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        if args.slashing_protection_db:
+            open(args.slashing_protection_db, "w").write(protection.export_json())
+    return 0
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd == "dev":
+        return asyncio.run(run_dev(args))
+    if args.cmd == "beacon":
+        return asyncio.run(run_beacon(args))
+    if args.cmd == "validator":
+        return asyncio.run(run_validator(args))
+    if args.cmd == "lightclient":
+        print("light client daemon: use lodestar_tpu.light_client.LightClient", file=sys.stderr)
+        return 2
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

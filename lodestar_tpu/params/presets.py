@@ -184,7 +184,20 @@ MINIMAL = Preset(
     UPDATE_TIMEOUT=64,
 )
 
-_PRESETS = {"mainnet": MAINNET, "minimal": MINIMAL}
+# Gnosis chain: mainnet-shaped state with a 5s slot cadence
+# (packages/params/src/presets/gnosis.ts — identical preset values to
+# mainnet; the chain differences live in the ChainConfig: SECONDS_PER_SLOT,
+# fork versions, deposit contract).  A distinct instance so `name`
+# round-trips through config/SSZ context checks.
+GNOSIS = Preset(
+    name="gnosis",
+    MAX_COMMITTEES_PER_SLOT=64,
+    TARGET_COMMITTEE_SIZE=128,
+    MAX_VALIDATORS_PER_COMMITTEE=2048,
+    SHUFFLE_ROUND_COUNT=90,
+)
+
+_PRESETS = {"mainnet": MAINNET, "minimal": MINIMAL, "gnosis": GNOSIS}
 
 
 def active_preset() -> Preset:

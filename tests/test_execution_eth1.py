@@ -1,0 +1,136 @@
+"""Execution engine (mock + HTTP JSON-RPC) and eth1 tracker tests.
+
+Reference flows: execution/engine/{http,mock}.ts,
+eth1/eth1DepositDataTracker.ts.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from lodestar_tpu.eth1 import Eth1DepositDataTracker, Eth1ProviderMock
+from lodestar_tpu.execution import (
+    DisabledExecutionEngine,
+    ExecutePayloadStatus,
+    ExecutionEngineHttp,
+    ExecutionEngineMock,
+)
+from lodestar_tpu.params import MINIMAL
+from lodestar_tpu.ssz import Fields
+from lodestar_tpu.state_transition.weak_subjectivity import (
+    compute_weak_subjectivity_period,
+    is_within_weak_subjectivity_period,
+)
+
+
+def test_engine_mock_payload_cycle():
+    eng = ExecutionEngineMock(MINIMAL)
+    pid = eng.notify_forkchoice_update(
+        b"\x00" * 32, b"\x00" * 32, b"\x00" * 32,
+        Fields(timestamp=12, prev_randao=b"\x01" * 32,
+               suggested_fee_recipient=b"\x02" * 20),
+    )
+    assert pid is not None
+    payload = eng.get_payload(pid)
+    assert payload.block_number == 0
+    assert eng.notify_new_payload(payload) == ExecutePayloadStatus.VALID
+    # chain a second payload on top
+    eng.notify_forkchoice_update(bytes(payload.block_hash), b"\x00" * 32, b"\x00" * 32,
+                                 Fields(timestamp=24, prev_randao=b"\x03" * 32,
+                                        suggested_fee_recipient=b"\x02" * 20))
+    p2 = eng.get_payload(eng.payload_id_seq)
+    assert p2.block_number == 1
+    assert bytes(p2.parent_hash) == bytes(payload.block_hash)
+
+
+def test_engine_disabled_raises():
+    eng = DisabledExecutionEngine()
+    with pytest.raises(RuntimeError):
+        eng.notify_new_payload(None)
+
+
+def test_engine_http_against_stub_server():
+    async def main():
+        seen = {}
+
+        async def handle(reader, writer):
+            data = await reader.read(65536)
+            body = json.loads(data.split(b"\r\n\r\n", 1)[1])
+            seen["method"] = body["method"]
+            if body["method"] == "engine_newPayloadV1":
+                result = {"status": "VALID", "latestValidHash": None}
+            else:
+                result = {"payloadStatus": {"status": "VALID"}, "payloadId": "0x01"}
+            resp = json.dumps({"jsonrpc": "2.0", "id": body["id"], "result": result}).encode()
+            writer.write(
+                b"HTTP/1.1 200 OK\r\ncontent-type: application/json\r\n"
+                + b"content-length: %d\r\n\r\n" % len(resp) + resp
+            )
+            await writer.drain()
+            writer.close()
+
+        server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        eng = ExecutionEngineHttp("127.0.0.1", port, jwt_supplier=lambda: "token")
+        payload = ExecutionEngineMock(MINIMAL)
+        pid = payload.notify_forkchoice_update(
+            b"\x00" * 32, b"\x00" * 32, b"\x00" * 32,
+            Fields(timestamp=1, prev_randao=b"\x00" * 32,
+                   suggested_fee_recipient=b"\x00" * 20),
+        )
+        p = payload.get_payload(pid)
+        status = await eng.notify_new_payload(p)
+        assert status == ExecutePayloadStatus.VALID
+        assert seen["method"] == "engine_newPayloadV1"
+        pid2 = await eng.notify_forkchoice_update(b"\x11" * 32, b"\x11" * 32, b"\x11" * 32)
+        assert pid2 == 1
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(main())
+
+
+def test_eth1_tracker_votes_and_deposits():
+    from lodestar_tpu.types import get_types
+
+    t = get_types(MINIMAL).phase0
+    provider = Eth1ProviderMock()
+    tracker = Eth1DepositDataTracker(MINIMAL, provider)
+    dd = Fields(
+        pubkey=b"\x01" * 48, withdrawal_credentials=b"\x02" * 32,
+        amount=32_000_000_000, signature=b"\x03" * 96,
+    )
+    provider.add_deposit(10, dd)
+    provider.advance_to(3000)
+    tracker.follow()
+    assert tracker.deposit_count == 1
+
+    # no period votes -> follow-distance snapshot
+    state = t.BeaconState.default()
+    vote = tracker.get_eth1_vote(state)
+    assert vote.deposit_count == 1
+    assert bytes(vote.block_hash) != b"\x00" * 32
+
+    # majority vote wins when it can still reach >1/2 of the period
+    leading = Fields(deposit_root=b"\x0a" * 32, deposit_count=5, block_hash=b"\x0b" * 32)
+    state.eth1_data_votes = [leading] * (
+        MINIMAL.EPOCHS_PER_ETH1_VOTING_PERIOD * MINIMAL.SLOTS_PER_EPOCH // 2 + 1
+    )
+    vote2 = tracker.get_eth1_vote(state)
+    assert bytes(vote2.block_hash) == b"\x0b" * 32
+
+
+def test_weak_subjectivity_period():
+    from lodestar_tpu.config.chain_config import ChainConfig
+    from lodestar_tpu.state_transition import interop_genesis_state
+
+    cfg = ChainConfig(
+        PRESET_BASE="minimal", MIN_GENESIS_TIME=0, SHARD_COMMITTEE_PERIOD=0,
+        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
+    )
+    state = interop_genesis_state(MINIMAL, cfg, 16, 1)
+    ws = compute_weak_subjectivity_period(MINIMAL, state)
+    assert ws >= 256  # never below the withdrawability delay
+    assert is_within_weak_subjectivity_period(MINIMAL, state, 0, ws)
+    assert not is_within_weak_subjectivity_period(MINIMAL, state, 0, ws + 1)
