@@ -56,11 +56,12 @@ class ApiError(Exception):
 
 class RestApiServer:
     def __init__(self, preset: Preset, chain, network=None, metrics_registry=None,
-                 host: str = "127.0.0.1"):
+                 metrics=None, host: str = "127.0.0.1"):
         self.p = preset
         self.chain = chain
         self.network = network
         self.metrics_registry = metrics_registry
+        self.metrics = metrics
         self.host = host
         self.port: Optional[int] = None
         self.t = get_types(preset).phase0
@@ -104,6 +105,8 @@ class RestApiServer:
                 if "content-length" in headers:
                     body = await reader.readexactly(int(headers["content-length"]))
                 status, payload, ctype = await self._dispatch(method, target, body)
+                if self.metrics:
+                    self.metrics.api_requests_total.labels(status=str(status)).inc()
                 data = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
                 writer.write(
                     b"HTTP/1.1 %d %s\r\n" % (status, b"OK" if status < 400 else b"Error")

@@ -77,11 +77,12 @@ class StateRegenerator:
     """getPreState / getBlockSlotState / getState (regen.ts), replaying from
     block storage when the cache misses."""
 
-    def __init__(self, preset: Preset, cfg: ChainConfig, block_source, state_cache: StateContextCache):
+    def __init__(self, preset: Preset, cfg: ChainConfig, block_source, state_cache: StateContextCache, metrics=None):
         self.p = preset
         self.cfg = cfg
         self.blocks = block_source  # mapping block_root -> SignedBeaconBlock
         self.cache = state_cache
+        self.metrics = metrics
         self.t = get_types(preset).phase0
 
     def get_state_by_block_root(self, block_root: bytes, max_replay: int = 32):
@@ -104,6 +105,8 @@ class StateRegenerator:
             if state is not None:
                 break
             root = parent
+        if self.metrics:
+            self.metrics.regen_replays_total.inc(len(chain))
         for block in reversed(chain):
             state, _ = state_transition(
                 self.p, self.cfg, state, block,

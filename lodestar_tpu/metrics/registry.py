@@ -115,6 +115,33 @@ class Metrics:
         self.gossip_queue_dropped_total = r.counter(
             "lodestar_gossip_queue_dropped_total", "dropped gossip jobs", labels=("topic",)
         )
+        # regen + state caches (regen/queued.ts metrics)
+        self.regen_replays_total = r.counter(
+            "lodestar_regen_replayed_blocks_total",
+            "blocks replayed to regenerate a state on cache miss",
+        )
+        self.state_cache_size = r.gauge(
+            "lodestar_state_cache_size", "states held in the LRU state cache"
+        )
+        # network (network/metrics.ts)
+        self.peers = r.gauge("lodestar_peers", "connected peers")
+        self.gossip_messages_total = r.counter(
+            "lodestar_gossip_messages_total", "gossip messages", labels=("dir",)
+        )
+        self.reqresp_requests_total = r.counter(
+            "lodestar_reqresp_requests_total", "req/resp requests", labels=("method", "dir")
+        )
+        # sync (sync/metrics)
+        self.sync_batches_total = r.counter(
+            "lodestar_range_sync_batches_total", "range sync batches imported"
+        )
+        self.sync_blocks_total = r.counter(
+            "lodestar_range_sync_blocks_total", "blocks imported via range sync"
+        )
+        # api server
+        self.api_requests_total = r.counter(
+            "lodestar_api_requests_total", "REST API requests", labels=("status",)
+        )
 
 
 def create_metrics() -> Metrics:

@@ -32,10 +32,11 @@ logger = get_logger("network")
 
 
 class Network:
-    def __init__(self, preset: Preset, chain, gossip_handlers=None, host: str = "127.0.0.1"):
+    def __init__(self, preset: Preset, chain, gossip_handlers=None, host: str = "127.0.0.1", metrics=None):
         self.p = preset
         self.chain = chain
         self.handlers = gossip_handlers
+        self.metrics = metrics
         self.host = host
         self.port: Optional[int] = None
         self.peer_manager = PeerManager()
@@ -83,6 +84,8 @@ class Network:
         peer._gossip_send = gossip_send
         self.router.add_peer_sender(gossip_send)
         self.peer_manager.add(peer)
+        if self.metrics:
+            self.metrics.peers.set(len(self.peer_manager.peers))
         task = asyncio.create_task(self._read_loop(peer))
         peer.tasks.append(task)
         if initiator:
@@ -99,6 +102,8 @@ class Network:
                     peer.reqresp.on_response_frame(kind, payload)
                 elif kind == KIND_GOSSIP:
                     topic, data = Wire.decode_gossip(payload)
+                    if self.metrics:
+                        self.metrics.gossip_messages_total.labels(dir="rx").inc()
                     await self.router.on_message(topic, data)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
@@ -113,6 +118,8 @@ class Network:
         if goodbye:
             await peer.reqresp.goodbye()
         self.peer_manager.remove(peer.peer_id)
+        if self.metrics:
+            self.metrics.peers.set(len(self.peer_manager.peers))
         self.router.remove_peer_sender(getattr(peer, "_gossip_send", None))
         peer.wire.close()
         for t in peer.tasks:

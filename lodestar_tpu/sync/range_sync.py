@@ -29,10 +29,11 @@ class SyncState(str, enum.Enum):
 
 
 class RangeSync:
-    def __init__(self, preset: Preset, chain, peer_manager):
+    def __init__(self, preset: Preset, chain, peer_manager, metrics=None):
         self.p = preset
         self.chain = chain
         self.peers = peer_manager
+        self.metrics = metrics
         self.state = SyncState.stalled
         self.batch_size = EPOCHS_PER_BATCH * preset.SLOTS_PER_EPOCH
 
@@ -65,7 +66,11 @@ class RangeSync:
                 self.state = SyncState.synced
                 return imported
             try:
-                imported += await self.chain.process_chain_segment(blocks)
+                n_ok = await self.chain.process_chain_segment(blocks)
+                imported += n_ok
+                if self.metrics:
+                    self.metrics.sync_batches_total.inc()
+                    self.metrics.sync_blocks_total.inc(n_ok)
             except Exception as e:  # noqa: BLE001
                 peer.penalize(10)
                 logger.warning("segment import failed: %s", e)

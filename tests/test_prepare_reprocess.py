@@ -1,0 +1,83 @@
+"""PrepareNextSlotScheduler + ReprocessController tests.
+
+Reference: chain/prepareNextSlot.ts:30, chain/reprocess.ts:51.
+"""
+
+import asyncio
+
+from lodestar_tpu.chain.bls_pool import BlsBatchPool
+from lodestar_tpu.chain.prepare_next_slot import (
+    PrepareNextSlotScheduler,
+    ReprocessController,
+)
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.node.dev_chain import DevChain
+from lodestar_tpu.params import MINIMAL
+
+CFG = ChainConfig(
+    PRESET_BASE="minimal", SHARD_COMMITTEE_PERIOD=0, MIN_GENESIS_TIME=0,
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
+    ALTAIR_FORK_EPOCH=2**64 - 1, BELLATRIX_FORK_EPOCH=2**64 - 1,
+)
+
+
+def test_prepare_next_slot_caches_advanced_state():
+    async def main():
+        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        dev = DevChain(MINIMAL, CFG, 16, pool)
+        await dev.run(2, with_attestations=False)
+        sched = PrepareNextSlotScheduler(MINIMAL, dev.chain)
+        head = dev.chain.head_root
+        next_slot = dev.chain.head_state().slot + 1
+        await sched.prepare(next_slot)
+        got = sched.get_prepared_state(head, next_slot)
+        assert got is not None
+        state, ctx = got
+        assert state.slot == next_slot
+        # mismatched head or slot -> miss
+        assert sched.get_prepared_state(b"\x00" * 32, next_slot) is None
+        assert sched.get_prepared_state(head, next_slot + 1) is None
+        pool.close()
+
+    asyncio.run(main())
+
+
+def test_reprocess_resolves_on_block_import():
+    async def main():
+        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        dev = DevChain(MINIMAL, CFG, 16, pool)
+        rc = ReprocessController(dev.chain)
+
+        # compute the root the next block WILL have, then wait for it
+        from lodestar_tpu.state_transition import clone_state, process_slots
+        from lodestar_tpu.state_transition.upgrade import block_types
+        from lodestar_tpu.ssz import Fields
+        from lodestar_tpu.state_transition import compute_epoch_at_slot
+
+        slot = 1
+        head_state = dev.chain.head_state()
+        pre = clone_state(dev.p, head_state)
+        ctx = process_slots(dev.p, CFG, pre, slot)
+        proposer = ctx.get_beacon_proposer(slot)
+        randao = dev._sign_randao(pre, proposer, compute_epoch_at_slot(dev.p, slot))
+        block, _ = dev.chain.produce_block(slot, randao)
+        future_root = block_types(dev.p, block).BeaconBlock.hash_tree_root(block)
+
+        async def delayed_import():
+            await asyncio.sleep(0.1)
+            sig = dev._sign_block(pre, block, proposer)
+            await dev.chain.process_block(Fields(message=block, signature=sig))
+
+        task = asyncio.create_task(delayed_import())
+        ok = await rc.wait_for_block(future_root, timeout=2.0)
+        await task
+        assert ok, "reprocess did not resolve on block import"
+
+        # unknown root times out False
+        assert not await rc.wait_for_block(b"\x42" * 32, timeout=0.1)
+        # known root resolves immediately
+        assert await rc.wait_for_block(future_root, timeout=0.1)
+        pool.close()
+
+    asyncio.run(main())
