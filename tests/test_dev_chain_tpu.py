@@ -1,0 +1,39 @@
+"""DevChain finality through the REAL batched device kernel.
+
+VERDICT r2 next-#2 done-criterion: the e2e chain exercises
+TpuBlsVerifier (CPU backend under pytest; the TPU backend runs the same
+program in bench.py), so "justification + finality through the batched
+verifier boundary" holds for the kernel, not just the Python oracle.
+Reference precedent: test/sim/multiNodeSingleThread.test.ts asserting
+finality against real components.
+"""
+
+import asyncio
+
+from lodestar_tpu.chain.bls_pool import BlsBatchPool
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.crypto.bls.tpu_verifier import TpuBlsVerifier
+from lodestar_tpu.node.dev_chain import DevChain
+from lodestar_tpu.params import MINIMAL
+
+CFG = ChainConfig(
+    PRESET_BASE="minimal", SHARD_COMMITTEE_PERIOD=0, MIN_GENESIS_TIME=0,
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
+    ALTAIR_FORK_EPOCH=2**64 - 1, BELLATRIX_FORK_EPOCH=2**64 - 1,
+)
+
+
+def test_dev_chain_finalizes_on_device_kernel():
+    async def main():
+        verifier = TpuBlsVerifier(buckets=(4, 8))
+        pool = BlsBatchPool(verifier, max_buffer_wait=0.005)
+        dev = DevChain(MINIMAL, CFG, 16, pool)
+        await dev.run(4 * MINIMAL.SLOTS_PER_EPOCH + 2)
+        state = dev.chain.head_state()
+        assert state.current_justified_checkpoint.epoch >= 3, "no justification"
+        assert state.finalized_checkpoint.epoch >= 2, "no finalization"
+        assert verifier.dispatches > 0, "kernel never dispatched"
+        assert verifier.sets_verified > 0
+        pool.close()
+
+    asyncio.run(main())
