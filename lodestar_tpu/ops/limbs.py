@@ -430,23 +430,54 @@ def _exp_bits(e: int) -> np.ndarray:
     return _EXP_BITS_CACHE[e]
 
 
+_EXP_WINDOWS_CACHE: dict = {}
+
+
+def _exp_windows(e: int) -> np.ndarray:
+    """Base-16 digits of e, MSB first (stable object per e — see the
+    constant-stability rule at RED_ROWS)."""
+    if e not in _EXP_WINDOWS_CACHE:
+        digits, v = [], e
+        while v:
+            digits.append(v & 0xF)
+            v >>= 4
+        _EXP_WINDOWS_CACHE[e] = np.array(list(reversed(digits)) or [0], dtype=np.int32)
+    return _EXP_WINDOWS_CACHE[e]
+
+
 @partial(jax.jit, static_argnums=(1,))
 def fp_pow_static(a: jnp.ndarray, e: int) -> jnp.ndarray:
-    """a^e for a static python-int exponent, via lax.scan square-and-multiply
-    (graph size O(1) in the exponent length; the body is branch-free)."""
+    """a^e for a static python-int exponent, via a 4-bit-windowed
+    square-and-multiply lax.scan.
+
+    Windowing matters for LATENCY, not flops: the scan is the only serial
+    part of a batched dispatch, and each iteration costs a fixed overhead
+    on TPU regardless of the batch width.  A 381-bit exponent runs 96
+    window iterations (4 squarings + one table multiply each) instead of
+    381 bit iterations — ~4x less serial depth for 1.6x fewer multiplies.
+    The 16-entry power table is gathered with a traced index (jnp.take
+    along the table axis), which XLA lowers to a dynamic-slice: no
+    control flow in the body.
+    """
     if e < 0:
         raise ValueError("negative exponent")
     if e == 0:
         return jnp.broadcast_to(jnp.asarray(ONE), a.shape).astype(DTYPE)
-    bits = jnp.asarray(_exp_bits(e))
+    windows = jnp.asarray(_exp_windows(e))
 
-    def body(r, bit):
-        r = fp_sqr(r)
-        r = fp_select(bit.astype(bool), fp_mul(r, a), r)
+    # power table a^0 .. a^15: 3 stacked multiply rounds
+    one = jnp.broadcast_to(jnp.asarray(ONE), a.shape).astype(DTYPE)
+    powers = [one, a]
+    for k in range(2, 16):
+        powers.append(fp_mul(powers[k // 2], powers[k - k // 2]))
+    table = jnp.stack(powers)  # (16, ..., 50)
+
+    def body(r, w):
+        r = fp_sqr(fp_sqr(fp_sqr(fp_sqr(r))))  # r^16
+        r = fp_mul(r, jnp.take(table, w, axis=0))
         return r, None
 
-    init = jnp.broadcast_to(jnp.asarray(ONE), a.shape).astype(DTYPE)
-    out, _ = lax.scan(body, init, bits)
+    out, _ = lax.scan(body, one, windows)
     return out
 
 
