@@ -1,0 +1,10 @@
+"""Native (C) runtime components.
+
+Reference parity: the reference's runtime leans on native deps for its
+host hot paths (as-sha256/hashtree for SSZ merkleization, snappy, blst —
+SURVEY.md §2.9).  The TPU framework keeps device compute in XLA and puts
+the host-side hot loops in small C libraries built on demand with the
+system compiler and bound via ctypes (no pybind11 in this image).
+"""
+
+from .hashtree import hash_layer, have_native, sha256  # noqa: F401

