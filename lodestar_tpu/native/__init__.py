@@ -7,4 +7,4 @@ the host-side hot loops in small C libraries built on demand with the
 system compiler and bound via ctypes (no pybind11 in this image).
 """
 
-from .hashtree import hash_layer, have_native, sha256  # noqa: F401
+from .hashtree import hash_layer, have_native  # noqa: F401

@@ -35,10 +35,14 @@ def _load() -> Optional[ctypes.CDLL]:
         try:
             if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
                 os.makedirs(os.path.dirname(_SO), exist_ok=True)
+                # build to a process-unique temp path and rename into place:
+                # concurrent importers must never dlopen a half-written .so
+                tmp = f"{_SO}.{os.getpid()}.tmp"
                 subprocess.run(
-                    ["cc", "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
+                    ["cc", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
                     check=True, capture_output=True, timeout=60,
                 )
+                os.replace(tmp, _SO)
             lib = ctypes.CDLL(_SO)
             lib.hashtree_hash_layer.argtypes = [
                 ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p
@@ -64,23 +68,14 @@ def have_native() -> bool:
 
 def hash_layer(data: bytes) -> bytes:
     """Hash consecutive 64-byte blocks into 32-byte digests (one merkle
-    layer step)."""
+    layer step).  Callers gate on have_native(); without the lib this
+    falls back to the caller's own hashlib path via ssz.core."""
     lib = _load()
+    if lib is None:  # pragma: no cover - callers check have_native() first
+        from ..ssz.core import _hashlib_hash_layer
+
+        return _hashlib_hash_layer(data)
     n = len(data) // 64
-    if lib is None:
-        out = bytearray(n * 32)
-        for i in range(0, len(data), 64):
-            out[i // 2 : i // 2 + 32] = hashlib.sha256(data[i : i + 64]).digest()
-        return bytes(out)
     buf = ctypes.create_string_buffer(n * 32)
     lib.hashtree_hash_layer(data, n, buf)
     return buf.raw
-
-
-def sha256(data: bytes) -> bytes:
-    lib = _load()
-    if lib is None:
-        return hashlib.sha256(data).digest()
-    out = ctypes.create_string_buffer(32)
-    lib.hashtree_sha256(data, len(data), out)
-    return out.raw

@@ -48,19 +48,26 @@ def _hashlib_hash_layer(data: bytes) -> bytes:
     return bytes(out)
 
 
-_hash_layer = _hashlib_hash_layer
+def _resolve_hash_layer(data: bytes) -> bytes:
+    """Lazy backend resolution on the FIRST layer hash: the native build
+    (csrc/hashtree.c, SHA-NI when the CPU has it — one FFI call per merkle
+    LAYER, ~18x the per-pair hashlib loop) may invoke the system compiler,
+    which must not block `import lodestar_tpu.ssz` on cold starts."""
+    global _hash_layer
+    backend = _hashlib_hash_layer
+    try:  # pragma: no cover - environment-dependent
+        from ..native import hashtree as _native_hashtree
 
-# Native merkle-layer backend (csrc/hashtree.c, SHA-NI when the CPU has
-# it): one FFI call per LAYER instead of a Python loop of hashlib calls —
-# ~18x on this image's hosts.  The binding self-checks against hashlib at
-# load and silently stays on the fallback if the toolchain is absent.
-try:  # pragma: no cover - environment-dependent
-    from ..native import hashtree as _native_hashtree
+        if _native_hashtree.have_native():
+            backend = _native_hashtree.hash_layer
+    except Exception:  # noqa: BLE001
+        pass
+    if _hash_layer is _resolve_hash_layer:  # not overridden meanwhile
+        _hash_layer = backend
+    return _hash_layer(data)
 
-    if _native_hashtree.have_native():
-        _hash_layer = _native_hashtree.hash_layer
-except Exception:  # noqa: BLE001
-    pass
+
+_hash_layer = _resolve_hash_layer
 
 
 def set_hash_backend(fn) -> None:
