@@ -173,6 +173,7 @@ class RestApiServer:
         r("POST", "/eth/v1/beacon/pool/voluntary_exits", self._submit_exit)
         r("GET", "/eth/v1/validator/aggregate_attestation", self._aggregate_attestation)
         r("POST", "/eth/v1/validator/aggregate_and_proofs", self._submit_aggregates)
+        r("POST", "/eth/v1/validator/liveness/{epoch}", self._liveness)
         r("GET", "/metrics", self._metrics)
 
     def _state_for(self, state_id: str):
@@ -420,6 +421,17 @@ class RestApiServer:
         if errors:
             raise ApiError(400, json.dumps(errors))
         return {}
+
+    def _liveness(self, pp, q, b):
+        """Validator liveness per epoch from the chain's seen-block-attester
+        cache (api/impl/validator liveness; backs doppelganger checks)."""
+        epoch = int(pp["epoch"])
+        seen = self.chain.seen_block_attesters
+        out = []
+        for idx in b or []:
+            i = int(idx)
+            out.append({"index": str(i), "is_live": seen.is_known(epoch, i)})
+        return {"data": out}
 
     def _metrics(self, pp, q, b):
         if self.metrics_registry is None:

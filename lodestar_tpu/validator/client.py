@@ -24,13 +24,48 @@ logger = get_logger("validator")
 
 
 class ValidatorClient:
-    def __init__(self, preset: Preset, cfg: ChainConfig, store: ValidatorStore, api: ApiClient):
+    def __init__(self, preset: Preset, cfg: ChainConfig, store: ValidatorStore, api: ApiClient,
+                 doppelganger_epochs: int = 0):
         self.p = preset
         self.cfg = cfg
         self.store = store
         self.api = api
         self._attester_duties: Dict[int, List[dict]] = {}  # epoch -> duties
         self._proposer_duties: Dict[int, List[dict]] = {}
+        # doppelganger protection (validator.ts + services/doppelgangerService):
+        # observe N full epochs of chain liveness before signing anything;
+        # if one of our validators attests during the window, another
+        # instance is live with our keys -> refuse to start
+        self.doppelganger_epochs = doppelganger_epochs
+        self._doppelganger_clear_epoch: Optional[int] = None
+
+    class DoppelgangerDetected(Exception):
+        pass
+
+    async def check_doppelganger(self, current_epoch: int) -> bool:
+        """True once the observation window has passed clean.  Raises
+        DoppelgangerDetected if any of our validators was seen attesting."""
+        if self.doppelganger_epochs == 0:
+            return True
+        if self._doppelganger_clear_epoch is None:
+            self._doppelganger_clear_epoch = current_epoch + self.doppelganger_epochs
+        if current_epoch < self._doppelganger_clear_epoch:
+            # liveness probe via the validator liveness API (the reference's
+            # doppelgangerService polls the same endpoint)
+            indices = [str(i) for i in self.store.keys]
+            try:
+                resp = await self.api.post(
+                    f"/eth/v1/validator/liveness/{max(0, current_epoch - 1)}", indices
+                )
+            except Exception:
+                return False  # cannot prove liveness either way: keep waiting
+            live = [d for d in resp.get("data", []) if d.get("is_live")]
+            if live:
+                raise self.DoppelgangerDetected(
+                    f"validators {[d['index'] for d in live]} are live elsewhere"
+                )
+            return False
+        return True
 
     # -- duties (services/attestationDuties.ts / blockDuties.ts) --------------
 

@@ -131,3 +131,36 @@ def test_vc_store_refuses_double_vote_via_signing_path():
     store.sign_attestation(0, data1)
     with pytest.raises(SlashingError):
         store.sign_attestation(0, data2)
+
+
+def test_doppelganger_detection_via_liveness():
+    async def main():
+        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        dev = DevChain(MINIMAL, CFG, N, pool)
+        # run an epoch with attestations so the block-attester cache fills
+        await dev.run(MINIMAL.SLOTS_PER_EPOCH + 2)
+        server = RestApiServer(MINIMAL, dev.chain)
+        port = await server.listen(0)
+        api = ApiClient("127.0.0.1", port)
+
+        keys = {i: interop_secret_key(i) for i in range(4)}
+        gvr = bytes(dev.chain.genesis_state.genesis_validators_root)
+        store = ValidatorStore(MINIMAL, CFG, keys, genesis_validators_root=gvr)
+        vc = ValidatorClient(MINIMAL, CFG, store, api, doppelganger_epochs=2)
+
+        # epoch 1: our validators attested in the dev run -> detected
+        import pytest as _pytest
+        with _pytest.raises(ValidatorClient.DoppelgangerDetected):
+            await vc.check_doppelganger(2)
+
+        # a fresh key set outside the chain's validators is clean
+        far_keys = {10_000 + i: interop_secret_key(i) for i in range(2)}
+        store2 = ValidatorStore(MINIMAL, CFG, far_keys, genesis_validators_root=gvr)
+        vc2 = ValidatorClient(MINIMAL, CFG, store2, api, doppelganger_epochs=1)
+        assert not await vc2.check_doppelganger(2)  # window not elapsed
+        assert await vc2.check_doppelganger(3)      # window passed clean
+
+        await server.close()
+        pool.close()
+
+    asyncio.run(main())
