@@ -198,12 +198,12 @@ def _pow_x_abs(f):
     multiplies) for half the iteration-latency.  f must be in the
     cyclotomic subgroup (callers only use it there)."""
     one = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), f.shape).astype(fl.DTYPE)
-    f2 = tw.fq12_sqr(f)
+    f2 = tw.fq12_cyc_sqr(f)
     f3 = tw.fq12_mul(f2, f)
     table = jnp.stack([one, f, f2, f3])  # (4, ..., 6, 2, 50)
 
     def body(r, w):
-        r = tw.fq12_sqr(tw.fq12_sqr(r))  # r^4
+        r = tw.fq12_cyc_sqr(tw.fq12_cyc_sqr(r))  # r^4 (cyclotomic)
         r = tw.fq12_mul(r, jnp.take(table, w, axis=0))
         return r, None
 
@@ -239,7 +239,7 @@ def final_exponentiation(f):
         tw.fq12_mul(_pow_x(_pow_x(y2)), tw.fq12_frobenius(tw.fq12_frobenius(y2))),
         tw.fq12_conj(y2),
     )  # ^(x^2 + p^2 - 1)
-    m2 = tw.fq12_sqr(m)
+    m2 = tw.fq12_cyc_sqr(m)
     return tw.fq12_mul(y3, tw.fq12_mul(m2, m))
 
 

@@ -326,6 +326,56 @@ def fq12_sqr(a: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack(C0 + C1, axis=-3)
 
 
+@jax.jit
+def fq12_cyc_sqr(a: jnp.ndarray) -> jnp.ndarray:
+    """Granger-Scott cyclotomic squaring — valid ONLY for elements of the
+    cyclotomic subgroup (everything after the easy final-exp part).
+
+    Via Fq4 = Fq2[Y]/(Y^2 - xi) squarings of the pairs (x0,x4), (x3,x2),
+    (x1,x5):  sq4(a,b) = (a^2 + xi b^2, (a+b)^2 - a^2 - b^2), then
+        z0 = 3 t0  - 2 x0      z3 = 3 xi t5 + 2 x3
+        z1 = 3 t2  - 2 x1      z4 = 3 t1    + 2 x4
+        z2 = 3 t4  - 2 x2      z5 = 3 t3    + 2 x5
+    (mapping derived numerically against the bigint oracle and pinned by
+    tests/test_ops_tower.py).  9 Fq2 squarings = 18 fp-mul lanes in ONE
+    stacked multiply — half a generic fq12_sqr, on the serial critical
+    path of every pow-by-x scan.
+    """
+    X = _fq12_comps(a)
+    s = fp_strict
+    pairs = [(X[0], X[4]), (X[3], X[2]), (X[1], X[5])]
+    sq_in = []
+    for u, v in pairs:
+        sq_in += [u, v, s(fp_add(u, v))]
+    # one flat 9-lane fq2 squaring: fq2_sqr(w) uses lanes (w0+w1)(w0-w1)
+    # and w0*w1 — stack them all through fq2_mul_many-compatible fp calls
+    stacked = jnp.stack(sq_in, axis=-3)  # (..., 9, 2, 50)
+    w0, w1 = stacked[..., 0, :], stacked[..., 1, :]
+    lhs = jnp.stack([s(fp_add(w0, w1)), w0], axis=-2)  # (..., 9, 2, 50) fp lanes
+    rhs = jnp.stack([fp_sub(w0, w1), w1], axis=-2)
+    t = fp_mul(lhs, rhs)
+    c0 = t[..., 0, :]
+    c1 = s(fp_add(t[..., 1, :], t[..., 1, :]))
+    sq = jnp.stack([c0, c1], axis=-2)  # (..., 9, 2, 50): squares of sq_in
+    SQ = [sq[..., i, :, :] for i in range(9)]
+    zs = []
+    t_even, t_odd = [], []
+    for k in range(3):
+        a2, b2, ab2 = SQ[3 * k], SQ[3 * k + 1], SQ[3 * k + 2]
+        t_even.append(s(fp_add(a2, fq2_mul_by_xi(b2))))          # a^2 + xi b^2
+        t_odd.append(fp_sub(ab2, fp_add(a2, b2)))                 # 2ab
+    t0, t2, t4 = t_even
+    t1, t3, t5 = t_odd
+    z0 = fp_sub(fp_add(fp_add(t0, t0), t0), fp_add(X[0], X[0]))
+    z1 = fp_sub(fp_add(fp_add(t2, t2), t2), fp_add(X[1], X[1]))
+    z2 = fp_sub(fp_add(fp_add(t4, t4), t4), fp_add(X[2], X[2]))
+    xt5 = fq2_mul_by_xi(t5)
+    z3 = s(fp_add(fp_add(fp_add(xt5, xt5), xt5), fp_add(X[3], X[3])))
+    z4 = s(fp_add(fp_add(fp_add(t1, t1), t1), fp_add(X[4], X[4])))
+    z5 = s(fp_add(fp_add(fp_add(t3, t3), t3), fp_add(X[5], X[5])))
+    return jnp.stack([z0, z1, z2, z3, z4, z5], axis=-3)
+
+
 def fq12_conj(a: jnp.ndarray) -> jnp.ndarray:
     """x -> x^(p^6); on the cyclotomic subgroup this is x^-1."""
     A = _fq12_comps(a)
