@@ -174,6 +174,10 @@ class RestApiServer:
         r("GET", "/eth/v1/validator/aggregate_attestation", self._aggregate_attestation)
         r("POST", "/eth/v1/validator/aggregate_and_proofs", self._submit_aggregates)
         r("POST", "/eth/v1/validator/liveness/{epoch}", self._liveness)
+        r("POST", "/eth/v1/validator/duties/sync/{epoch}", self._sync_duties)
+        r("POST", "/eth/v1/beacon/pool/sync_committees", self._submit_sync_messages)
+        r("GET", "/eth/v1/validator/sync_committee_contribution", self._sync_contribution)
+        r("POST", "/eth/v1/validator/contribution_and_proofs", self._submit_contributions)
         r("GET", "/metrics", self._metrics)
 
     def _state_for(self, state_id: str):
@@ -432,6 +436,82 @@ class RestApiServer:
             i = int(idx)
             out.append({"index": str(i), "is_live": seen.is_known(epoch, i)})
         return {"data": out}
+
+    def _sync_duties(self, pp, q, b):
+        """Sync-committee duties: which requested validators sit in the
+        CURRENT sync committee and on which subnets (validator duties/sync)."""
+        from ..chain.sync_committee_pools import subcommittee_assignment
+        from ..state_transition.upgrade import state_fork_name
+        from ..config.fork_config import ForkName
+
+        state = self.chain.head_state()
+        if state_fork_name(state) == ForkName.phase0:
+            return {"data": []}
+        duties = []
+        for idx in b or []:
+            i = int(idx)
+            subs = subcommittee_assignment(self.p, state, i)
+            if subs:
+                duties.append(
+                    {
+                        "pubkey": "0x" + bytes(state.validators[i].pubkey).hex(),
+                        "validator_index": str(i),
+                        "validator_sync_committee_indices": [str(s) for s in subs],
+                    }
+                )
+        return {"data": duties}
+
+    async def _submit_sync_messages(self, pp, q, b):
+        """Validate + pool sync-committee messages (beacon/pool/sync_committees)."""
+        from ..chain.seen_cache import SeenSyncCommitteeMessages
+        from ..chain.sync_committee_pools import (
+            subcommittee_assignment,
+            validate_sync_committee_message,
+        )
+        from ..state_transition import EpochContext
+
+        chain = self.chain
+        if not hasattr(self, "_seen_sync_msgs"):
+            self._seen_sync_msgs = SeenSyncCommitteeMessages()
+        state = chain.head_state()
+        ctx = EpochContext.create_from_state(self.p, state)
+        errors = []
+        for i, msg_json in enumerate(b or []):
+            msg = from_json(msg_json)
+            try:
+                subs = subcommittee_assignment(self.p, state, msg.validator_index)
+                if not subs:
+                    raise ApiError(400, "validator not in sync committee")
+                subnet = subs[0]
+                idx = await validate_sync_committee_message(
+                    self.p, chain.cfg, message=msg, subnet=subnet,
+                    clock_slot=msg.slot, state=state, ctx=ctx,
+                    seen_sync_msgs=self._seen_sync_msgs, pool=chain.bls,
+                )
+                chain.sync_msg_pool.add(
+                    msg.slot, bytes(msg.beacon_block_root), subnet, idx,
+                    bytes(msg.signature),
+                )
+            except Exception as e:  # noqa: BLE001
+                errors.append({"index": i, "message": str(e)})
+        if errors:
+            raise ApiError(400, json.dumps(errors))
+        return {}
+
+    def _sync_contribution(self, pp, q, b):
+        slot = int(q["slot"])
+        sub = int(q["subcommittee_index"])
+        root = bytes.fromhex(q["beacon_block_root"][2:])
+        c = self.chain.sync_msg_pool.get_contribution(slot, root, sub)
+        if c is None:
+            raise ApiError(404, "no contribution available")
+        return {"data": to_json(c)}
+
+    async def _submit_contributions(self, pp, q, b):
+        for sc_json in b or []:
+            sc = from_json(sc_json)
+            self.chain.contribution_pool.add(sc.message.contribution)
+        return {}
 
     def _metrics(self, pp, q, b):
         if self.metrics_registry is None:

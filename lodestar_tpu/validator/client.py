@@ -178,7 +178,62 @@ class ValidatorClient:
             submitted += 1
         return submitted
 
+    # -- sync-committee duties (services/syncCommittee.ts) ---------------------
+
+    async def sync_committee_duties(self, slot: int) -> int:
+        """Sign + submit sync-committee messages over the head root; for
+        aggregator validators, fetch the pooled contribution and publish a
+        signed ContributionAndProof."""
+        from ..chain.sync_committee_pools import is_sync_committee_aggregator
+
+        indices = [str(i) for i in self.store.keys]
+        epoch = compute_epoch_at_slot(self.p, slot)
+        try:
+            duties = (await self.api.post(f"/eth/v1/validator/duties/sync/{epoch}", indices))["data"]
+        except Exception:
+            return 0  # pre-altair node
+        if not duties:
+            return 0
+        head = await self.api.get("/eth/v1/beacon/headers/head")
+        head_root = bytes.fromhex(head["data"]["root"][2:])
+        msgs = []
+        for d in duties:
+            vi = int(d["validator_index"])
+            msgs.append(to_json(self.store.sign_sync_committee_message(vi, slot, head_root)))
+        await self.api.post("/eth/v1/beacon/pool/sync_committees", msgs)
+        submitted = len(msgs)
+        # aggregation phase
+        done_subs = set()
+        for d in duties:
+            vi = int(d["validator_index"])
+            for sub_s in d["validator_sync_committee_indices"]:
+                sub = int(sub_s)
+                if sub in done_subs:
+                    continue
+                proof = self.store.sign_sync_selection_proof(vi, slot, sub)
+                if not is_sync_committee_aggregator(self.p, proof):
+                    continue
+                done_subs.add(sub)
+                try:
+                    c = await self.api.get(
+                        f"/eth/v1/validator/sync_committee_contribution?slot={slot}"
+                        f"&subcommittee_index={sub}&beacon_block_root=0x{head_root.hex()}"
+                    )
+                except Exception:
+                    continue
+                contribution = from_json(c["data"])
+                msg = Fields(
+                    aggregator_index=vi, contribution=contribution, selection_proof=proof
+                )
+                sig = self.store.sign_contribution_and_proof(vi, msg)
+                await self.api.post(
+                    "/eth/v1/validator/contribution_and_proofs",
+                    [to_json(Fields(message=msg, signature=sig))],
+                )
+        return submitted
+
     async def run_slot(self, slot: int) -> None:
         await self.propose_if_due(slot)
         await self.attest(slot)
         await self.aggregate(slot)
+        await self.sync_committee_duties(slot)

@@ -96,6 +96,47 @@ class ValidatorStore:
         )
         return self.keys[validator_index].sign(root).to_bytes()
 
+    def sign_sync_committee_message(
+        self, validator_index: int, slot: int, beacon_block_root: bytes
+    ) -> Fields:
+        """SyncCommitteeMessage (services/syncCommittee.ts signing path)."""
+        from ..params import DOMAIN_SYNC_COMMITTEE
+
+        epoch = compute_epoch_at_slot(self.p, slot)
+        domain = self._domain(DOMAIN_SYNC_COMMITTEE, epoch)
+        root = self.t.SigningData.hash_tree_root(
+            Fields(object_root=beacon_block_root, domain=domain)
+        )
+        return Fields(
+            slot=slot,
+            beacon_block_root=beacon_block_root,
+            validator_index=validator_index,
+            signature=self.keys[validator_index].sign(root).to_bytes(),
+        )
+
+    def sign_sync_selection_proof(
+        self, validator_index: int, slot: int, subcommittee_index: int
+    ) -> bytes:
+        from ..params import DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF
+        from ..types import get_types as _gt
+
+        epoch = compute_epoch_at_slot(self.p, slot)
+        domain = self._domain(DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, epoch)
+        t_alt = _gt(self.p).altair
+        data = Fields(slot=slot, subcommittee_index=subcommittee_index)
+        root = compute_signing_root(self.p, t_alt.SyncAggregatorSelectionData, data, domain)
+        return self.keys[validator_index].sign(root).to_bytes()
+
+    def sign_contribution_and_proof(self, validator_index: int, message) -> bytes:
+        from ..params import DOMAIN_CONTRIBUTION_AND_PROOF
+        from ..types import get_types as _gt
+
+        epoch = compute_epoch_at_slot(self.p, message.contribution.slot)
+        domain = self._domain(DOMAIN_CONTRIBUTION_AND_PROOF, epoch)
+        t_alt = _gt(self.p).altair
+        root = compute_signing_root(self.p, t_alt.ContributionAndProof, message, domain)
+        return self.keys[validator_index].sign(root).to_bytes()
+
     def sign_voluntary_exit(self, validator_index: int, exit_epoch: int) -> Fields:
         msg = Fields(epoch=exit_epoch, validator_index=validator_index)
         domain = self._domain(DOMAIN_VOLUNTARY_EXIT, exit_epoch)
