@@ -178,6 +178,8 @@ class RestApiServer:
         r("POST", "/eth/v1/beacon/pool/sync_committees", self._submit_sync_messages)
         r("GET", "/eth/v1/validator/sync_committee_contribution", self._sync_contribution)
         r("POST", "/eth/v1/validator/contribution_and_proofs", self._submit_contributions)
+        r("GET", "/eth/v1/beacon/light_client/bootstrap/{block_root}", self._lc_bootstrap)
+        r("GET", "/eth/v1/beacon/light_client/updates", self._lc_updates)
         r("GET", "/metrics", self._metrics)
 
     def _state_for(self, state_id: str):
@@ -512,6 +514,34 @@ class RestApiServer:
             sc = from_json(sc_json)
             self.chain.contribution_pool.add(sc.message.contribution)
         return {}
+
+    def _lc_bootstrap(self, pp, q, b):
+        """Light-client bootstrap for a trusted block root
+        (beacon/light_client/bootstrap/{block_root}; served from the
+        chain's LightClientServer when one is attached)."""
+        lc = getattr(self, "light_client_server", None)
+        if lc is None:
+            raise ApiError(404, "light client server not enabled")
+        root = bytes.fromhex(pp["block_root"][2:])
+        boot = lc.get_bootstrap(root)
+        if boot is None:
+            raise ApiError(404, "bootstrap unavailable for that root")
+        return {"data": to_json(boot)}
+
+    def _lc_updates(self, pp, q, b):
+        """Best updates by sync period range
+        (beacon/light_client/updates?start_period=&count=)."""
+        lc = getattr(self, "light_client_server", None)
+        if lc is None:
+            raise ApiError(404, "light client server not enabled")
+        start = int(q.get("start_period", 0))
+        count = min(int(q.get("count", 1)), 128)
+        out = []
+        for period in range(start, start + count):
+            u = lc.get_update(period)
+            if u is not None:
+                out.append({"data": to_json(u)})
+        return {"data": [o["data"] for o in out]}
 
     def _metrics(self, pp, q, b):
         if self.metrics_registry is None:

@@ -76,8 +76,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     lc = sub.add_parser("lightclient", help="light client (cmds/lightclient)")
     lc.add_argument("--beacon-url", default="http://127.0.0.1:9596")
-    lc.add_argument("--checkpoint-root", required=False)
+    lc.add_argument("--checkpoint-root", required=False,
+                    help="trusted block root (default: the node's finalized root)")
     lc.add_argument("--preset", choices=["mainnet", "minimal"], default="minimal")
+    lc.add_argument("--poll-seconds", type=float, default=12.0)
+    lc.add_argument("--max-polls", type=int, default=0, help="0 = forever")
     return ap
 
 
@@ -107,7 +110,7 @@ async def run_dev(args) -> int:
     metrics = MetricsRegistry() if args.metrics else None
     dev = DevChain(preset, cfg, args.validators, pool, db=db)
     handlers = GossipHandlers(dev.chain)
-    LightClientServer(preset, dev.chain)
+    lc_server = LightClientServer(preset, dev.chain)
     network = Network(preset, dev.chain, handlers)
     await network.listen(args.listen_port)
     for target in args.connect:
@@ -115,6 +118,7 @@ async def run_dev(args) -> int:
         await network.connect(host, int(port))
     rest = RestApiServer(preset, dev.chain, network=network, metrics_registry=metrics)
     rest.gossip_handlers = handlers
+    rest.light_client_server = lc_server
     await rest.listen(args.rest_port)
     logger.info("dev chain: %d validators, %s preset", args.validators, args.preset)
     n = args.slots if args.slots else 1 << 62
@@ -230,6 +234,57 @@ async def run_validator(args) -> int:
     return 0
 
 
+async def run_lightclient(args) -> int:
+    """Follow the chain as a light client over the REST API
+    (cmds/lightclient/handler.ts)."""
+    from .api.client import ApiClient
+    from .api.serde import from_json
+    from .light_client import LightClient
+
+    preset = _preset(args.preset)
+    cfg = ChainConfig(PRESET_BASE=args.preset, MIN_GENESIS_TIME=0,
+                      SHARD_COMMITTEE_PERIOD=0,
+                      MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16)
+    url = args.beacon_url.rstrip("/")
+    host = url.split("//")[-1].split(":")[0]
+    port = int(url.rsplit(":", 1)[-1])
+    api = ApiClient(host, port)
+    genesis = await api.get("/eth/v1/beacon/genesis")
+    gvr = bytes.fromhex(genesis["data"]["genesis_validators_root"][2:])
+    root = args.checkpoint_root
+    if not root:
+        fc = await api.get("/eth/v1/beacon/states/head/finality_checkpoints")
+        root = fc["data"]["finalized"]["root"]
+    boot = await api.get(f"/eth/v1/beacon/light_client/bootstrap/{root}")
+    client = LightClient(preset, cfg, from_json(boot["data"]), gvr)
+    logger.info("light client bootstrapped at slot %d", client.finalized_header.slot)
+    polls = 0
+    period = 0
+    while args.max_polls == 0 or polls < args.max_polls:
+        polls += 1
+        try:
+            ups = await api.get(
+                f"/eth/v1/beacon/light_client/updates?start_period={period}&count=4"
+            )
+            for u in ups["data"]:
+                client.process_update(from_json(u))
+            print(
+                json.dumps(
+                    {
+                        "optimistic_slot": int(client.optimistic_header.slot),
+                        "finalized_slot": int(client.finalized_header.slot),
+                    }
+                ),
+                flush=True,
+            )
+        except Exception as e:  # noqa: BLE001
+            logger.warning("update poll failed: %s", e)
+        if args.max_polls and polls >= args.max_polls:
+            break
+        await asyncio.sleep(args.poll_seconds)
+    return 0
+
+
 def main(argv: Optional[list] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.cmd == "dev":
@@ -239,8 +294,7 @@ def main(argv: Optional[list] = None) -> int:
     if args.cmd == "validator":
         return asyncio.run(run_validator(args))
     if args.cmd == "lightclient":
-        print("light client daemon: use lodestar_tpu.light_client.LightClient", file=sys.stderr)
-        return 2
+        return asyncio.run(run_lightclient(args))
     return 2
 
 

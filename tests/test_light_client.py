@@ -68,3 +68,37 @@ def test_light_client_follows_finality():
         pool.close()
 
     asyncio.run(main())
+
+
+def test_light_client_over_rest_api():
+    async def main():
+        from lodestar_tpu.api import ApiClient, RestApiServer
+        from lodestar_tpu.api.serde import from_json
+
+        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        dev = DevChain(MINIMAL, CFG, N, pool)
+        server = LightClientServer(MINIMAL, dev.chain)
+        await dev.run(5 * MINIMAL.SLOTS_PER_EPOCH + 2)
+
+        rest = RestApiServer(MINIMAL, dev.chain)
+        rest.light_client_server = server
+        port = await rest.listen(0)
+        api = ApiClient("127.0.0.1", port)
+
+        boot_root = dev.chain.fork_choice.get_ancestor(
+            dev.chain.head_root, MINIMAL.SLOTS_PER_EPOCH + 1
+        )
+        boot = await api.get(f"/eth/v1/beacon/light_client/bootstrap/0x{boot_root.hex()}")
+        gvr = bytes(dev.chain.genesis_state.genesis_validators_root)
+        lc = LightClient(MINIMAL, CFG, from_json(boot["data"]), gvr)
+
+        ups = await api.get("/eth/v1/beacon/light_client/updates?start_period=0&count=4")
+        assert ups["data"], "no updates served"
+        for u in ups["data"]:
+            lc.process_update(from_json(u))
+        assert lc.finalized_header.slot > 0
+
+        await rest.close()
+        pool.close()
+
+    asyncio.run(main())
