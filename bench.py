@@ -74,11 +74,14 @@ def bench_cpu_oracle(n: int = 2):
         sk = interop_secret_key(i)
         msg = bytes([i]) * 32
         sets.append((sk.to_public_key(), msg, sk.sign(msg)))
-    t0 = time.perf_counter()
-    ok = verify_multiple_signatures(sets)
-    dt = time.perf_counter() - t0
-    assert ok
-    return n / dt
+    best = None
+    for _ in range(3):  # best-of-3: a single 2-set run is timing-noisy
+        t0 = time.perf_counter()
+        ok = verify_multiple_signatures(sets)
+        dt = time.perf_counter() - t0
+        assert ok
+        best = dt if best is None else min(best, dt)
+    return n / best
 
 
 def bench_dev_chain(time_budget_s: float = 150.0):
