@@ -56,7 +56,15 @@ class GossipHandlers:
     def _head_ctx_state(self, slot: int):
         """Head state advanced to `slot` for committee lookups (the
         reference uses the wall-clock state via regen; head-at-slot is the
-        same state for canonical gossip)."""
+        same state for canonical gossip).
+
+        Memoized per (head_root, slot): gossip bursts validate hundreds of
+        objects against the same dial state, and a full state clone +
+        slot advance per message is the exact DoS shape ADVICE r2 flagged
+        for the exit validator."""
+        key = (self.chain.head_root, slot)
+        if getattr(self, "_ctx_memo_key", None) == key:
+            return self._ctx_memo_val
         state = clone_state(self.p, self.chain.head_state())
         if state.slot < slot:
             ctx = process_slots(self.p, self.cfg, state, slot)
@@ -66,6 +74,8 @@ class GossipHandlers:
                 from ..state_transition import EpochContext
 
                 ctx = EpochContext.create_from_state(self.p, state)
+        self._ctx_memo_key = key
+        self._ctx_memo_val = (ctx, state)
         return ctx, state
 
     def _clock_slot(self) -> int:

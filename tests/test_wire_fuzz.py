@@ -1,0 +1,63 @@
+"""Adversarial wire input: malformed frames must not crash a node.
+
+Reference analog: reqresp/gossip decoders are the node's untrusted-input
+surface (network/reqresp error handling tests).
+"""
+
+import asyncio
+import secrets
+
+from lodestar_tpu.chain.bls_pool import BlsBatchPool
+from lodestar_tpu.chain.handlers import GossipHandlers
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.network import Network
+from lodestar_tpu.network.wire import write_uvarint
+from lodestar_tpu.node.dev_chain import DevChain
+from lodestar_tpu.params import MINIMAL
+
+CFG = ChainConfig(
+    PRESET_BASE="minimal", SHARD_COMMITTEE_PERIOD=0, MIN_GENESIS_TIME=0,
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
+    ALTAIR_FORK_EPOCH=2**64 - 1, BELLATRIX_FORK_EPOCH=2**64 - 1,
+)
+
+
+def test_malformed_frames_do_not_kill_the_node():
+    async def main():
+        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        a = DevChain(MINIMAL, CFG, 16, pool)
+        net = Network(MINIMAL, a.chain, GossipHandlers(a.chain))
+        port = await net.listen(0)
+
+        async def blast(payloads):
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            for p in payloads:
+                writer.write(p)
+            await writer.drain()
+            writer.close()
+
+        # garbage kinds, truncated uvarints, random bodies, oversized claims
+        await blast([
+            bytes([0x77]) + write_uvarint(5) + b"\x01\x02\x03\x04\x05",
+            bytes([0x01]) + write_uvarint(3) + b"\xff\xff\xff",     # bad request body
+            bytes([0x04]) + write_uvarint(10) + secrets.token_bytes(10),  # bad gossip
+            bytes([0x02]) + write_uvarint(2) + b"\x00",             # truncated chunk
+        ])
+        await asyncio.sleep(0.2)
+        # oversized length claim drops the peer but not the server
+        await blast([bytes([0x01]) + write_uvarint(1 << 30)])
+        await asyncio.sleep(0.2)
+
+        # the node still accepts well-behaved peers afterwards
+        b = DevChain(MINIMAL, CFG, 16, pool)
+        net_b = Network(MINIMAL, b.chain, GossipHandlers(b.chain))
+        peer = await net_b.connect("127.0.0.1", port)
+        assert peer.status is not None
+        assert await peer.reqresp.ping(3) == 3
+
+        await net_b.close()
+        await net.close()
+        pool.close()
+
+    asyncio.run(main())
