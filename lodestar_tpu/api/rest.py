@@ -484,16 +484,21 @@ class RestApiServer:
                 subs = subcommittee_assignment(self.p, state, msg.validator_index)
                 if not subs:
                     raise ApiError(400, "validator not in sync committee")
-                subnet = subs[0]
                 idx = await validate_sync_committee_message(
-                    self.p, chain.cfg, message=msg, subnet=subnet,
+                    self.p, chain.cfg, message=msg, subnet=subs[0],
                     clock_slot=msg.slot, state=state, ctx=ctx,
                     seen_sync_msgs=self._seen_sync_msgs, pool=chain.bls,
                 )
-                chain.sync_msg_pool.add(
-                    msg.slot, bytes(msg.beacon_block_root), subnet, idx,
-                    bytes(msg.signature),
-                )
+                # the committee samples with replacement: pool the message at
+                # EVERY position the validator occupies, not just the first
+                pk = bytes(state.validators[msg.validator_index].pubkey)
+                width = self.p.SYNC_COMMITTEE_SUBNET_SIZE
+                for pos, cpk in enumerate(state.current_sync_committee.pubkeys):
+                    if bytes(cpk) == pk:
+                        chain.sync_msg_pool.add(
+                            msg.slot, bytes(msg.beacon_block_root),
+                            pos // width, pos % width, bytes(msg.signature),
+                        )
             except Exception as e:  # noqa: BLE001
                 errors.append({"index": i, "message": str(e)})
         if errors:
@@ -510,9 +515,32 @@ class RestApiServer:
         return {"data": to_json(c)}
 
     async def _submit_contributions(self, pp, q, b):
-        for sc_json in b or []:
+        """Validate (aggregator selection + all three signatures) before
+        pooling — an unvalidated all-bits contribution would otherwise win
+        every pool slot and poison produced blocks."""
+        from ..chain.sync_committee_pools import validate_sync_committee_contribution
+        from ..state_transition import EpochContext
+
+        chain = self.chain
+        if not hasattr(self, "_seen_contributions"):
+            self._seen_contributions = set()
+        state = chain.head_state()
+        ctx = EpochContext.create_from_state(self.p, state)
+        errors = []
+        for i, sc_json in enumerate(b or []):
             sc = from_json(sc_json)
-            self.chain.contribution_pool.add(sc.message.contribution)
+            try:
+                await validate_sync_committee_contribution(
+                    self.p, chain.cfg, signed_contribution=sc,
+                    clock_slot=sc.message.contribution.slot, state=state,
+                    ctx=ctx, seen_contributions=self._seen_contributions,
+                    pool=chain.bls,
+                )
+                chain.contribution_pool.add(sc.message.contribution)
+            except Exception as e:  # noqa: BLE001
+                errors.append({"index": i, "message": str(e)})
+        if errors:
+            raise ApiError(400, json.dumps(errors))
         return {}
 
     def _lc_bootstrap(self, pp, q, b):
@@ -540,8 +568,8 @@ class RestApiServer:
         for period in range(start, start + count):
             u = lc.get_update(period)
             if u is not None:
-                out.append({"data": to_json(u)})
-        return {"data": [o["data"] for o in out]}
+                out.append(to_json(u))
+        return {"data": out}
 
     def _metrics(self, pp, q, b):
         if self.metrics_registry is None:

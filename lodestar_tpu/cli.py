@@ -259,10 +259,13 @@ async def run_lightclient(args) -> int:
     client = LightClient(preset, cfg, from_json(boot["data"]), gvr)
     logger.info("light client bootstrapped at slot %d", client.finalized_header.slot)
     polls = 0
-    period = 0
+    slots_per_period = preset.SLOTS_PER_EPOCH * preset.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
     while args.max_polls == 0 or polls < args.max_polls:
         polls += 1
         try:
+            # resume from the period of our best header so the follow loop
+            # advances with the chain instead of refetching period 0
+            period = int(client.finalized_header.slot) // slots_per_period
             ups = await api.get(
                 f"/eth/v1/beacon/light_client/updates?start_period={period}&count=4"
             )

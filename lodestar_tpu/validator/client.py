@@ -37,25 +37,34 @@ class ValidatorClient:
         # if one of our validators attests during the window, another
         # instance is live with our keys -> refuse to start
         self.doppelganger_epochs = doppelganger_epochs
-        self._doppelganger_clear_epoch: Optional[int] = None
+        self._doppelganger_window: Optional[set] = None
 
     class DoppelgangerDetected(Exception):
         pass
 
     async def check_doppelganger(self, current_epoch: int) -> bool:
-        """True once the observation window has passed clean.  Raises
-        DoppelgangerDetected if any of our validators was seen attesting."""
+        """True once EVERY epoch of the observation window has been probed
+        clean via the liveness API.  Raises DoppelgangerDetected if any of
+        our validators was seen attesting in a probed epoch.
+
+        Window = the immediately-past epoch plus the next
+        ``doppelganger_epochs`` epochs; an epoch becomes probe-able only
+        after it has completed, so the final window epoch is actually
+        queried before the check clears (the reference's
+        doppelgangerService semantics)."""
         if self.doppelganger_epochs == 0:
             return True
-        if self._doppelganger_clear_epoch is None:
-            self._doppelganger_clear_epoch = current_epoch + self.doppelganger_epochs
-        if current_epoch < self._doppelganger_clear_epoch:
-            # liveness probe via the validator liveness API (the reference's
-            # doppelgangerService polls the same endpoint)
-            indices = [str(i) for i in self.store.keys]
+        if self._doppelganger_window is None:
+            self._doppelganger_window = set(
+                range(max(0, current_epoch - 1), current_epoch + self.doppelganger_epochs)
+            )
+        indices = [str(i) for i in self.store.keys]
+        for epoch in sorted(self._doppelganger_window):
+            if epoch >= current_epoch:
+                continue  # not complete yet — probe on a later call
             try:
                 resp = await self.api.post(
-                    f"/eth/v1/validator/liveness/{max(0, current_epoch - 1)}", indices
+                    f"/eth/v1/validator/liveness/{epoch}", indices
                 )
             except Exception:
                 return False  # cannot prove liveness either way: keep waiting
@@ -64,8 +73,8 @@ class ValidatorClient:
                 raise self.DoppelgangerDetected(
                     f"validators {[d['index'] for d in live]} are live elsewhere"
                 )
-            return False
-        return True
+            self._doppelganger_window.discard(epoch)
+        return not self._doppelganger_window
 
     # -- duties (services/attestationDuties.ts / blockDuties.ts) --------------
 
@@ -233,6 +242,11 @@ class ValidatorClient:
         return submitted
 
     async def run_slot(self, slot: int) -> None:
+        if self.doppelganger_epochs:
+            # no duty signs anything until the observation window clears
+            if not await self.check_doppelganger(compute_epoch_at_slot(self.p, slot)):
+                logger.info("doppelganger window open — skipping duties for slot %d", slot)
+                return
         await self.propose_if_due(slot)
         await self.attest(slot)
         await self.aggregate(slot)
