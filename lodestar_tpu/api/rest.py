@@ -19,6 +19,15 @@ api/impl/beacon/ (genesis/headers/blocks/pool).  Routes implemented:
   GET  /eth/v1/validator/attestation_data?slot=&committee_index=
   POST /eth/v1/beacon/pool/attestations
   POST /eth/v1/beacon/pool/voluntary_exits
+  GET  /eth/v1/validator/aggregate_attestation?slot=&attestation_data_root=
+  POST /eth/v1/validator/aggregate_and_proofs
+  POST /eth/v1/validator/liveness/{epoch}
+  POST /eth/v1/validator/duties/sync/{epoch}
+  POST /eth/v1/beacon/pool/sync_committees
+  GET  /eth/v1/validator/sync_committee_contribution?slot=&subcommittee_index=&beacon_block_root=
+  POST /eth/v1/validator/contribution_and_proofs
+  GET  /eth/v1/beacon/light_client/bootstrap/{block_root}
+  GET  /eth/v1/beacon/light_client/updates?start_period=&count=
   GET  /metrics  (prometheus text exposition when a registry is wired)
 """
 
