@@ -56,12 +56,20 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument("--altair-epoch", type=int, default=None)
         p.add_argument("--bellatrix-epoch", type=int, default=None)
         p.add_argument("--validators", type=int, default=16)
+        p.add_argument(
+            "--bls-verifier",
+            choices=("auto", "tpu", "native", "python"),
+            default="auto",
+            help="signature verifier backend (auto: TPU kernel when a TPU "
+            "is present, else native C, else pure python) — the selection "
+            "seam of chain/chain.ts:146-148",
+        )
 
     dev = sub.add_parser("dev", help="single-process interop chain (cmds/dev)")
     common(dev)
     dev.add_argument("--slots", type=int, default=32, help="slots to run (0 = forever)")
     dev.add_argument("--tpu-bls", action="store_true",
-                     help="verify signatures on the TPU batched kernel")
+                     help="alias for --bls-verifier tpu")
 
     beacon = sub.add_parser("beacon", help="beacon node (cmds/beacon)")
     common(beacon)
@@ -98,13 +106,7 @@ async def run_dev(args) -> int:
 
     preset = _preset(args.preset)
     cfg = _chain_config(args)
-    if args.tpu_bls:
-        from .crypto.bls.tpu_verifier import TpuBlsVerifier
-
-        verifier = TpuBlsVerifier()
-    else:
-        verifier = PyBlsVerifier()
-    pool = BlsBatchPool(verifier)
+    pool = BlsBatchPool(_make_verifier(args))
     controller = SqliteDbController(args.db) if args.db else MemoryDbController()
     db = BeaconDb(preset, controller)
     metrics = MetricsRegistry() if args.metrics else None
@@ -139,6 +141,39 @@ async def run_dev(args) -> int:
     return 0
 
 
+def _make_verifier(args):
+    """The verifier selection seam (reference chain.ts:146-148 picks the
+    worker pool by default; here: TPU kernel by default when a TPU backend
+    exists, native C otherwise, pure-Python oracle as last resort)."""
+    choice = getattr(args, "bls_verifier", "auto")
+    if getattr(args, "tpu_bls", False):
+        choice = "tpu"
+    if choice == "auto":
+        try:
+            import jax
+
+            choice = "tpu" if jax.default_backend() not in ("cpu",) else "native"
+        except Exception:
+            choice = "native"
+    if choice == "tpu":
+        from .crypto.bls.tpu_verifier import TpuBlsVerifier
+
+        logger.info("bls verifier: TPU batched kernel (host final exp)")
+        return TpuBlsVerifier()
+    if choice == "native":
+        from .crypto.bls.native_verifier import FastBlsVerifier
+
+        v = FastBlsVerifier()
+        if v.native:
+            logger.info("bls verifier: native C (csrc/fastbls.c)")
+            return v
+        logger.warning("native bls unavailable; falling back to python oracle")
+    from .crypto.bls.verifier import PyBlsVerifier
+
+    logger.info("bls verifier: pure-python oracle")
+    return PyBlsVerifier()
+
+
 async def run_beacon(args) -> int:
     """Boot a (non-producing) beacon node: db-resumed or genesis state,
     network listener, REST API; follows peers via range sync + gossip.
@@ -166,7 +201,7 @@ async def run_beacon(args) -> int:
     else:
         resumed = db.last_archived_state()
         genesis = resumed or interop_genesis_state(preset, cfg, args.validators, 1)
-    pool = BlsBatchPool(PyBlsVerifier())
+    pool = BlsBatchPool(_make_verifier(args))
     chain = BeaconChain(preset, cfg, genesis, pool, db=db)
     handlers = GossipHandlers(chain)
     network = Network(preset, chain, handlers)
@@ -207,12 +242,9 @@ async def run_validator(args) -> int:
     host = url.split("//")[-1].split(":")[0]
     port = int(url.rsplit(":", 1)[-1])
     api = ApiClient(host, port)
-    protection = SlashingProtection()
-    if args.slashing_protection_db:
-        try:
-            protection.import_json(open(args.slashing_protection_db).read())
-        except FileNotFoundError:
-            pass
+    # persist_path: every accepted record is WAL'd before the signature is
+    # released, so a crash/SIGKILL cannot lose signing history (ADVICE r3)
+    protection = SlashingProtection(persist_path=args.slashing_protection_db)
     genesis = await api.get("/eth/v1/beacon/genesis")
     gvr = bytes.fromhex(genesis["data"]["genesis_validators_root"][2:])
     store = ValidatorStore(preset, cfg, keys, protection, genesis_validators_root=gvr)
@@ -229,8 +261,7 @@ async def run_validator(args) -> int:
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
-        if args.slashing_protection_db:
-            open(args.slashing_protection_db, "w").write(protection.export_json())
+        protection.close()  # fold the WAL into the interchange file
     return 0
 
 

@@ -47,7 +47,7 @@ class ForkChoice:
         store: ForkChoiceStore,
         anchor: ProtoNode,
         proposer_boost_pct: int = 40,
-        committee_fraction_per_slot: Optional[int] = None,
+        slots_per_epoch: int = 32,
     ):
         self.store = store
         self.proto = ProtoArray(
@@ -59,6 +59,7 @@ class ForkChoice:
         self.balances = store.justified_balances.copy()
         self.proposer_boost_root: Optional[bytes] = None
         self.proposer_boost_pct = proposer_boost_pct
+        self.slots_per_epoch = slots_per_epoch
         self._applied_boost: Optional[tuple] = None  # (root, amount) in current weights
         self._head: Optional[bytes] = None
 
@@ -82,6 +83,7 @@ class ForkChoice:
         justified_balances: Optional[np.ndarray] = None,
         is_timely_proposal: bool = False,
         execution_status: str = "pre-merge",
+        execution_block_hash: bytes = b"\x00" * 32,
     ) -> None:
         if not self.proto.has_block(parent_root):
             raise ForkChoiceError("unknown parent")
@@ -103,6 +105,7 @@ class ForkChoice:
                 justified_epoch=justified_checkpoint.epoch,
                 finalized_epoch=finalized_checkpoint.epoch,
                 execution_status=execution_status,
+                execution_block_hash=execution_block_hash,
             )
         )
 
@@ -137,7 +140,10 @@ class ForkChoice:
         if self.proposer_boost_root is not None:
             bi = self.proto.indices.get(self.proposer_boost_root)
             if bi is not None:
-                committee_weight = int(new_balances.sum()) // max(1, 32)  # avg per slot
+                # average committee weight per slot (getProposerScore:
+                # total active balance / SLOTS_PER_EPOCH — preset-dependent,
+                # 8 on minimal, 32 on mainnet)
+                committee_weight = int(new_balances.sum()) // max(1, self.slots_per_epoch)
                 boost = committee_weight * self.proposer_boost_pct // 100
                 deltas[bi] += boost
                 self._applied_boost = (self.proposer_boost_root, boost)
@@ -180,14 +186,29 @@ class ForkChoice:
                 node.execution_status = "valid"
 
     def on_invalid_execution(self, root: bytes) -> None:
-        """Mark a block and all its descendants invalid."""
-        bad = {root}
+        """Mark a block and all its descendants invalid, zero their weight
+        out of every ancestor, and refresh best-child/best-descendant
+        pointers so the next find_head provably lands on a valid branch
+        (protoArray.ts propagateInvalidation + the applyScoreChanges
+        invalid-node delta override)."""
         idx = self.proto.indices.get(root)
         if idx is None:
             return
+        bad = {root}
         self.proto.nodes[idx].execution_status = "invalid"
+        # descendants come after the parent: ProtoArray.on_block appends and
+        # prune() preserves order, so one forward sweep covers the subtree
         for i in range(idx + 1, len(self.proto.nodes)):
             node = self.proto.nodes[i]
             if node.parent_root in bad:
                 node.execution_status = "invalid"
                 bad.add(node.block_root)
+        # zero-delta score pass: apply_score_changes forces invalid nodes'
+        # weight to 0 (subtracting the subtree from ancestors) and re-runs
+        # the best-pointer bubble so pointers never target invalid nodes
+        self.proto.apply_score_changes(
+            np.zeros(len(self.proto.nodes), dtype=np.int64),
+            self.store.justified_checkpoint.epoch,
+            self.store.finalized_checkpoint.epoch,
+        )
+        self._head = None

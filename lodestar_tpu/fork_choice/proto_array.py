@@ -34,6 +34,8 @@ class ProtoNode:
     best_descendant: Optional[int] = None
     # execution status for optimistic sync (forkChoice.ts ExecutionStatus)
     execution_status: str = "pre-merge"  # pre-merge | syncing | valid | invalid
+    # EL block hash carried for engine_forkchoiceUpdated calls
+    execution_block_hash: bytes = b"\x00" * 32
 
 
 @dataclasses.dataclass
@@ -60,10 +62,13 @@ def compute_deltas(
         old_bal = int(old_balances[i]) if i < len(old_balances) else 0
         new_bal = int(new_balances[i]) if i < len(new_balances) else 0
         if vote.current_root != vote.next_root or old_bal != new_bal:
-            cur = indices.get(vote.current_root)
+            # the zero root is the "no vote yet" sentinel, never a block —
+            # skip it explicitly so an anchor whose root happens to be low
+            # can't absorb phantom deltas
+            cur = indices.get(vote.current_root) if vote.current_root != zero else None
             if cur is not None:
                 deltas[cur] -= old_bal
-            nxt = indices.get(vote.next_root)
+            nxt = indices.get(vote.next_root) if vote.next_root != zero else None
             if nxt is not None:
                 deltas[nxt] += new_bal
             vote.current_root = vote.next_root
@@ -110,6 +115,12 @@ class ProtoArray:
         for i in range(len(self.nodes) - 1, -1, -1):
             node = self.nodes[i]
             delta = int(deltas[i])
+            if node.execution_status == "invalid":
+                # EL-invalidated subtree: force weight to 0 and propagate
+                # only that change upward — stray vote-removal deltas on an
+                # already-zeroed node are discarded (ancestors shed the
+                # subtree the moment it was invalidated)
+                delta = -node.weight
             node.weight += delta
             if node.weight < 0:
                 raise ProtoArrayError("negative node weight")

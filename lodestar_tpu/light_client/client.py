@@ -50,6 +50,9 @@ class LightClient:
         self.cfg = cfg
         self.t = get_types(preset)
         self.gvr = genesis_validators_root
+        from ..config.fork_config import ForkConfig
+
+        self.fork_config = ForkConfig(cfg)
         self.finalized_header = bootstrap.header
         self.optimistic_header = bootstrap.header
         self.current_sync_committee = bootstrap.current_sync_committee
@@ -63,6 +66,12 @@ class LightClient:
             bytes(bootstrap.header.state_root),
         ):
             raise LightClientError("invalid bootstrap sync committee proof")
+
+    def _sync_period(self, slot: int) -> int:
+        return (
+            compute_epoch_at_slot(self.p, slot)
+            // self.p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        )
 
     def _field_index(self, name: str) -> int:
         fields = [f for f, _ in self.t.altair.BeaconState.fields]
@@ -96,13 +105,38 @@ class LightClient:
             if not _verify_branch(fin_root, update.finality_branch, idx, state_root):
                 raise LightClientError("invalid finality branch")
 
-        # sync aggregate signature by the CURRENT committee over the
-        # attested header under DOMAIN_SYNC_COMMITTEE
+        # sync aggregate signature over the attested header under
+        # DOMAIN_SYNC_COMMITTEE.  The signing committee is the one of the
+        # SIGNATURE slot's period: the store's current committee for a
+        # same-period update, the proven next committee for the update
+        # that crosses into the following period (spec
+        # validate_light_client_update committee selection).  The fork
+        # version is derived from OUR fork schedule at the signature slot —
+        # trusting update.fork_version would let a malicious server pick
+        # whichever domain it likes (ADVICE r3)
         from ..crypto.bls.api import PublicKey
         from ..state_transition.altair import eth_fast_aggregate_verify
 
+        store_period = self._sync_period(self.finalized_header.slot)
+        sig_slot = attested.slot + 1
+        sig_period = self._sync_period(sig_slot)
+        if sig_period == store_period:
+            committee = self.current_sync_committee
+        elif sig_period == store_period + 1 and self.next_sync_committee is not None:
+            committee = self.next_sync_committee
+        else:
+            raise LightClientError(
+                f"update signature period {sig_period} outside known committees"
+                f" (store period {store_period})"
+            )
+        # spec validate_light_client_update: fork version at
+        # epoch(max(signature_slot, 1) - 1) — the aggregate is signed with
+        # the domain of the slot BEFORE the signature slot, so an update
+        # straddling a fork activation must use the pre-fork version
+        sig_epoch = compute_epoch_at_slot(self.p, max(sig_slot, 1) - 1)
+        fork_version = self.fork_config.get_fork_version(sig_epoch)
         domain = compute_domain(
-            self.p, DOMAIN_SYNC_COMMITTEE, bytes(update.fork_version), self.gvr
+            self.p, DOMAIN_SYNC_COMMITTEE, fork_version, self.gvr
         )
         signing_root = self.t.phase0.SigningData.hash_tree_root(
             Fields(
@@ -112,9 +146,7 @@ class LightClient:
         )
         pks = [
             PublicKey.from_bytes(bytes(pk))
-            for pk, bit in zip(
-                self.current_sync_committee.pubkeys, agg.sync_committee_bits
-            )
+            for pk, bit in zip(committee.pubkeys, agg.sync_committee_bits)
             if bit
         ]
         if not eth_fast_aggregate_verify(
@@ -122,23 +154,32 @@ class LightClient:
         ):
             raise LightClientError("invalid sync aggregate signature")
 
-        # apply
-        self.next_sync_committee = update.next_sync_committee
+        # apply (spec apply_light_client_update): a finalized header
+        # crossing into the next period rotates next->current and installs
+        # the update's own proven next committee; advancing more than one
+        # period at a time, or crossing without a known next committee,
+        # would leave the store without the committee needed to verify
+        # anything afterwards — reject instead of desyncing silently.
+        attested_period = self._sync_period(attested.slot)
         if attested.slot > self.optimistic_header.slot:
             self.optimistic_header = attested
         if finalized.slot > self.finalized_header.slot:
-            old_period = (
-                compute_epoch_at_slot(self.p, self.finalized_header.slot)
-                // self.p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
-            )
-            new_period = (
-                compute_epoch_at_slot(self.p, finalized.slot)
-                // self.p.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
-            )
-            self.finalized_header = finalized
-            if new_period > old_period and self.next_sync_committee is not None:
-                # period rotation: the proven next committee becomes current
+            new_period = self._sync_period(finalized.slot)
+            if new_period == store_period + 1:
+                if self.next_sync_committee is None:
+                    raise LightClientError("period rotation without known next committee")
                 self.current_sync_committee = self.next_sync_committee
+                # the update's next committee is proven against the attested
+                # state; it names new_period's successor only when the
+                # attested header itself sits in new_period
+                self.next_sync_committee = (
+                    update.next_sync_committee if attested_period == new_period else None
+                )
+            elif new_period > store_period + 1:
+                raise LightClientError("update skips a sync-committee period")
+            self.finalized_header = finalized
+        if attested_period == store_period and self.next_sync_committee is None:
+            self.next_sync_committee = update.next_sync_committee
         logger.info(
             "light client advanced: optimistic slot %d, finalized slot %d",
             self.optimistic_header.slot, self.finalized_header.slot,

@@ -36,6 +36,21 @@ METHOD_BLOCKS_BY_ROOT = 5
 
 MAX_REQUEST_BLOCKS = 1024
 
+# Per-method response-chunk ceilings (reference: maxResponseChunks wired
+# into each protocol def, reqResp.ts:94-127).  Single-chunk methods get 1;
+# block methods get MAX_REQUEST_BLOCKS.  A malicious server streaming more
+# chunks than its method allows is cut off instead of OOM-ing the client.
+MAX_RESPONSE_CHUNKS = {
+    METHOD_STATUS: 1,
+    METHOD_GOODBYE: 1,
+    METHOD_PING: 1,
+    METHOD_METADATA: 1,
+    METHOD_BLOCKS_BY_RANGE: MAX_REQUEST_BLOCKS,
+    METHOD_BLOCKS_BY_ROOT: MAX_REQUEST_BLOCKS,
+}
+# total decompressed bytes a single request may accumulate client-side
+MAX_RESPONSE_TOTAL_BYTES = 128 * 1024 * 1024
+
 
 class RequestError(Exception):
     def __init__(self, result: int, message: str = ""):
@@ -64,17 +79,33 @@ class ReqRespNode:
         req_id = next(self._req_ids)
         q: asyncio.Queue = asyncio.Queue()
         self._pending[req_id] = q
+        # overall deadline, not per-chunk: a malicious peer must not keep a
+        # request alive forever by trickling chunks (ADVICE r3 — the
+        # per-chunk wait_for reset the timeout on every chunk)
+        deadline = asyncio.get_event_loop().time() + timeout
+        max_chunks = MAX_RESPONSE_CHUNKS.get(method, 1)
+        total = 0
         try:
             from .wire import KIND_REQUEST
 
             await self.wire.send_frame(KIND_REQUEST, Wire.encode_request(method, req_id, ssz_bytes))
             chunks: List[bytes] = []
             while True:
-                kind, result, body = await asyncio.wait_for(q.get(), timeout)
+                remaining = deadline - asyncio.get_event_loop().time()
+                if remaining <= 0:
+                    raise asyncio.TimeoutError()
+                kind, result, body = await asyncio.wait_for(q.get(), remaining)
                 if kind == KIND_RESPONSE_END:
                     return chunks
                 if result != RESULT_SUCCESS:
                     raise RequestError(result, body.decode(errors="replace"))
+                total += len(body)
+                if len(chunks) >= max_chunks:
+                    raise RequestError(
+                        RESULT_INVALID_REQUEST, f"method {method} sent >{max_chunks} chunks"
+                    )
+                if total > MAX_RESPONSE_TOTAL_BYTES:
+                    raise RequestError(RESULT_INVALID_REQUEST, "response exceeds byte budget")
                 chunks.append(body)
         finally:
             self._pending.pop(req_id, None)

@@ -31,6 +31,10 @@ RESULT_INVALID_REQUEST = 1
 RESULT_SERVER_ERROR = 2
 
 MAX_PAYLOAD = 32 * 1024 * 1024
+# decompressed-size bound for any single wire message: matches the spec's
+# MAX_CHUNK_SIZE/GOSSIP_MAX_SIZE class of limits and stops a 32MB frame
+# from expanding into hundreds of MB host-side (decompression bomb)
+MAX_UNCOMPRESSED = 32 * 1024 * 1024
 
 
 def write_uvarint(n: int) -> bytes:
@@ -114,7 +118,7 @@ class Wire:
     def decode_request(payload: bytes) -> Tuple[int, int, bytes]:
         method, off = read_uvarint(payload)
         req_id, off = read_uvarint(payload, off)
-        return method, req_id, frame_uncompress(payload[off:])
+        return method, req_id, frame_uncompress(payload[off:], max_output=MAX_UNCOMPRESSED)
 
     @staticmethod
     def encode_response_chunk(req_id: int, result: int, ssz_bytes: bytes) -> bytes:
@@ -126,7 +130,7 @@ class Wire:
         if off >= len(payload):
             raise ValueError("truncated response chunk")
         result = payload[off]
-        return req_id, result, frame_uncompress(payload[off + 1 :])
+        return req_id, result, frame_uncompress(payload[off + 1 :], max_output=MAX_UNCOMPRESSED)
 
     @staticmethod
     def encode_response_end(req_id: int) -> bytes:
@@ -146,4 +150,4 @@ class Wire:
     def decode_gossip(payload: bytes) -> Tuple[str, bytes]:
         tlen, off = read_uvarint(payload)
         topic = payload[off : off + tlen].decode()
-        return topic, frame_uncompress(payload[off + tlen :])
+        return topic, frame_uncompress(payload[off + tlen :], max_output=MAX_UNCOMPRESSED)

@@ -42,15 +42,26 @@ def compute_weak_subjectivity_period(
     ws_period = MIN_VALIDATOR_WITHDRAWABILITY_DELAY
     if N == 0:
         return ws_period
-    t = sum(int(state.balances[i]) for i in active) // N // 10**9  # avg ETH
+    # t = average EFFECTIVE balance in ETH, via effective-balance increments
+    # (computeWeakSubjectivityPeriodFromConstituents uses
+    # totalActiveBalanceIncrements — raw balances above the 32 ETH cap would
+    # inflate ws_period beyond the verified formula; ADVICE r3)
+    increment = p.EFFECTIVE_BALANCE_INCREMENT
+    total_increments = sum(
+        int(state.validators[i].effective_balance) // increment for i in active
+    )
+    eth_per_increment = increment // 10**9  # 1 for mainnet/minimal presets
+    t = (total_increments // N) * eth_per_increment
     T = p.MAX_EFFECTIVE_BALANCE // 10**9
     delta = get_churn_limit(p, N)
     Delta = p.MAX_DEPOSITS * p.SLOTS_PER_EPOCH
     D = safety_decay
     if T * (200 + 3 * D) < t * (200 + 12 * D):
-        ws_period += (N * (t * (200 + 12 * D) - T * (200 + 3 * D))) // (
-            600 * delta * (2 * t + T)
-        )
+        epochs_for_validator_set_churn = (
+            N * (t * (200 + 12 * D) - T * (200 + 3 * D))
+        ) // (600 * delta * (2 * t + T))
+        epochs_for_balance_top_ups = (N * (200 + 3 * D)) // (600 * Delta)
+        ws_period += max(epochs_for_validator_set_churn, epochs_for_balance_top_ups)
     elif T != t:
         ws_period += (3 * N * D * t) // (200 * Delta * (T - t))
     return ws_period

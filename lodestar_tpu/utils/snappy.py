@@ -14,7 +14,7 @@ moderate ratio) — interop needs correct *decoding* primarily.
 from __future__ import annotations
 
 import struct
-from typing import List
+from typing import List, Optional
 
 # ---------------------------------------------------------------------------
 # varint
@@ -54,9 +54,16 @@ def _write_varint(n: int) -> bytes:
 # ---------------------------------------------------------------------------
 
 
-def uncompress(data: bytes) -> bytes:
-    """Snappy block-format decompression."""
+def uncompress(data: bytes, max_output: Optional[int] = None) -> bytes:
+    """Snappy block-format decompression.
+
+    ``max_output`` bounds the decoded size (checked against the declared
+    length up front AND inside the decode loop): untrusted wire input could
+    otherwise declare ~2^36 bytes and expand a small frame into hundreds of
+    MB via the byte-wise copy loop (decompression bomb, ADVICE r3)."""
     length, pos = _read_varint(data, 0)
+    if max_output is not None and length > max_output:
+        raise ValueError(f"declared length {length} exceeds bound {max_output}")
     out = bytearray()
     n = len(data)
     while pos < n:
@@ -74,6 +81,8 @@ def uncompress(data: bytes) -> bytes:
             size += 1
             if pos + size > n:
                 raise ValueError("truncated literal")
+            if len(out) + size > length:
+                raise ValueError("output exceeds declared length")
             out += data[pos : pos + size]
             pos += size
             continue
@@ -97,6 +106,8 @@ def uncompress(data: bytes) -> bytes:
             pos += 4
         if offset == 0 or offset > len(out):
             raise ValueError("invalid copy offset")
+        if len(out) + size > length:
+            raise ValueError("output exceeds declared length")
         for _ in range(size):  # overlapping copies must go byte-wise
             out.append(out[-offset])
     if len(out) != length:
@@ -226,7 +237,10 @@ def frame_compress(data: bytes) -> bytes:
     return bytes(out)
 
 
-def frame_uncompress(data: bytes) -> bytes:
+def frame_uncompress(data: bytes, max_output: Optional[int] = None) -> bytes:
+    """Framed decompression with the spec's 65536-byte uncompressed-chunk
+    limit enforced and an optional total-output bound (``max_output``) —
+    both required on untrusted peer input (ADVICE r3)."""
     pos = 0
     out = bytearray()
     n = len(data)
@@ -250,17 +264,21 @@ def frame_uncompress(data: bytes) -> bytes:
             raise ValueError("chunk body shorter than CRC")
         if ctype == 0x00:  # compressed
             crc = struct.unpack("<I", body[:4])[0]
-            chunk = uncompress(body[4:])
+            chunk = uncompress(body[4:], max_output=_MAX_UNCOMPRESSED_CHUNK)
             if _masked_crc(chunk) != crc:
                 raise ValueError("crc mismatch")
             out += chunk
         elif ctype == 0x01:  # uncompressed
             crc = struct.unpack("<I", body[:4])[0]
             chunk = body[4:]
+            if len(chunk) > _MAX_UNCOMPRESSED_CHUNK:
+                raise ValueError("uncompressed chunk exceeds 65536")
             if _masked_crc(chunk) != crc:
                 raise ValueError("crc mismatch")
             out += chunk
         elif ctype <= 0x7F:
             raise ValueError(f"unknown unskippable chunk type {ctype:#x}")
         # 0x80..0xfe: skippable, ignore
+        if max_output is not None and len(out) > max_output:
+            raise ValueError(f"frame output exceeds bound {max_output}")
     return bytes(out)

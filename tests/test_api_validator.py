@@ -115,6 +115,30 @@ def test_slashing_protection_blocks_double_signs():
         sp2.check_and_insert_block_proposal(pk, 9, b"\x02" * 32)
 
 
+def test_slashing_protection_wal_survives_crash(tmp_path):
+    # records must be durable the moment check_and_insert returns — a
+    # process that dies without close()/checkpoint() must still refuse the
+    # double sign after restart (ADVICE r3 high finding)
+    db = str(tmp_path / "protection.json")
+    sp = SlashingProtection(persist_path=db)
+    pk = b"\x22" * 48
+    sp.check_and_insert_attestation(pk, 0, 3, b"\xaa" * 32)
+    sp.check_and_insert_block_proposal(pk, 7, b"\x01" * 32)
+    # simulate crash: no close(), no checkpoint() — drop the object
+    del sp
+
+    sp2 = SlashingProtection(persist_path=db)
+    with pytest.raises(SlashingError):
+        sp2.check_and_insert_attestation(pk, 0, 3, b"\xbb" * 32)
+    with pytest.raises(SlashingError):
+        sp2.check_and_insert_block_proposal(pk, 7, b"\x02" * 32)
+    # graceful path folds the WAL into the interchange file
+    sp2.checkpoint()
+    sp3 = SlashingProtection(persist_path=db)
+    with pytest.raises(SlashingError):
+        sp3.check_and_insert_attestation(pk, 1, 2, b"\xcc" * 32)  # surrounded
+
+
 def test_vc_store_refuses_double_vote_via_signing_path():
     keys = {0: interop_secret_key(0)}
     store = ValidatorStore(MINIMAL, CFG, keys)

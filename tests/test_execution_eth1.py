@@ -134,3 +134,30 @@ def test_weak_subjectivity_period():
     assert ws >= 256  # never below the withdrawability delay
     assert is_within_weak_subjectivity_period(MINIMAL, state, 0, ws)
     assert not is_within_weak_subjectivity_period(MINIMAL, state, 0, ws + 1)
+
+    # raw balances above the 32 ETH cap must NOT inflate the period — the
+    # formula is defined over effective balances (ADVICE r3): a state with
+    # everyone holding 40 ETH raw but 32 effective gives the same period
+    for i in range(len(state.balances)):
+        state.balances[i] = 40 * 10**9
+    assert compute_weak_subjectivity_period(MINIMAL, state) == ws
+
+    # churn branch (t == T here) includes the balance-top-up floor:
+    # max(churn_term, N*(200+3D)//(600*Delta)) can exceed the churn term
+    # for huge N — sanity-check the term is wired by scaling N via a fake
+    class _V:
+        def __init__(self):
+            self.activation_epoch = 0
+            self.exit_epoch = 2**64 - 1
+            self.effective_balance = 32 * 10**9
+
+    class _S:
+        slot = 0
+        validators = [_V() for _ in range(200_000)]
+        balances = [32 * 10**9] * 200_000
+
+    big = compute_weak_subjectivity_period(MINIMAL, _S())
+    D, delta_ = 10, max(4, 200_000 // 65536)
+    churn_term = (200_000 * (32 * (200 + 120) - 32 * 230)) // (600 * delta_ * 96)
+    topup_term = (200_000 * 230) // (600 * MINIMAL.MAX_DEPOSITS * MINIMAL.SLOTS_PER_EPOCH)
+    assert big == 256 + max(churn_term, topup_term)
