@@ -1,0 +1,128 @@
+"""ctypes binding for csrc/fastbls.c (native BLS12-381) with
+build-on-demand and self-test gating.
+
+Three roles (see fastbls.c header):
+- honest CPU baseline for bench.py (portable-C blst counterpart),
+- host-side final exponentiation for the split TPU dispatch,
+- fast CPU fallback verifier (FastBlsVerifier in crypto/bls/native_verifier).
+
+Mirrors native/hashtree.py: compile once into build/, atomic rename so
+concurrent importers never dlopen a half-written .so, fb_selftest() must
+pass before the lib is trusted, and every caller has a pure-Python oracle
+fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+_LOCK = threading.Lock()
+
+_SRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "csrc",
+    "fastbls.c",
+)
+_SO = os.path.abspath(os.path.join(os.path.dirname(_SRC), "..", "build", "libfastbls.so"))
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        try:
+            hdr = os.path.join(os.path.dirname(_SRC), "fastbls_consts.h")
+            newest_src = max(os.path.getmtime(_SRC), os.path.getmtime(hdr))
+            if not os.path.exists(_SO) or os.path.getmtime(_SO) < newest_src:
+                os.makedirs(os.path.dirname(_SO), exist_ok=True)
+                tmp = f"{_SO}.{os.getpid()}.tmp"
+                subprocess.run(
+                    ["cc", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+                os.replace(tmp, _SO)
+            lib = ctypes.CDLL(_SO)
+            lib.fb_selftest.restype = ctypes.c_int
+            lib.fb_batch_verify.restype = ctypes.c_int
+            lib.fb_batch_verify.argtypes = [
+                ctypes.c_size_t,
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint32),
+                ctypes.c_char_p,
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.fb_verify_one.restype = ctypes.c_int
+            lib.fb_verify_one.argtypes = [ctypes.c_char_p] * 3
+            lib.fb_final_exp_is_one.restype = ctypes.c_int
+            lib.fb_final_exp_is_one.argtypes = [ctypes.c_char_p]
+            lib.fb_hash_to_g2.restype = ctypes.c_int
+            lib.fb_hash_to_g2.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t]
+            if lib.fb_selftest() != 1:
+                return None
+            _LIB = lib
+        except Exception:
+            _LIB = None
+        return _LIB
+
+
+def have_native() -> bool:
+    return _load() is not None
+
+
+def batch_verify(
+    sets: Sequence[Tuple[List[bytes], bytes, bytes]], coeffs: Sequence[int]
+) -> Optional[bool]:
+    """sets: (pubkeys_compressed[], signing_root32, signature_compressed96).
+    coeffs: odd 64-bit RLC coefficients, one per set.  Returns None when the
+    native lib is unavailable (caller falls back to the oracle); False on
+    malformed inputs or failed verification."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(sets)
+    if n == 0:
+        return False
+    pk_blob = b"".join(pk for pks, _, _ in sets for pk in pks)
+    counts = (ctypes.c_uint32 * n)(*[len(pks) for pks, _, _ in sets])
+    msgs = b"".join(m for _, m, _ in sets)
+    sigs = b"".join(s for _, _, s in sets)
+    if len(msgs) != 32 * n or len(sigs) != 96 * n:
+        return False
+    c_arr = (ctypes.c_uint64 * n)(*[c & 0xFFFFFFFFFFFFFFFF for c in coeffs])
+    return lib.fb_batch_verify(n, pk_blob, counts, msgs, sigs, c_arr) == 1
+
+
+def final_exp_is_one(f_bytes: bytes) -> Optional[bool]:
+    """Host tail of the split TPU dispatch: f_bytes = 12 x 48-byte BE fp
+    components in tower order (fastbls.c fb_final_exp_is_one)."""
+    lib = _load()
+    if lib is None:
+        return None
+    if len(f_bytes) != 576:
+        return False
+    return lib.fb_final_exp_is_one(f_bytes) == 1
+
+
+def hash_to_g2_affine(msg: bytes) -> Optional[Tuple[int, int, int, int]]:
+    """(x.c0, x.c1, y.c0, y.c1) ints, or None without the native lib."""
+    lib = _load()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(192)
+    if lib.fb_hash_to_g2(out, msg, len(msg)) != 1:
+        return None
+    raw = out.raw
+    return tuple(int.from_bytes(raw[48 * i : 48 * (i + 1)], "big") for i in range(4))

@@ -1,0 +1,137 @@
+"""Differential tests: csrc/fastbls.c vs the pure-Python bigint oracle.
+
+The native library is only trusted because every primitive is pinned to
+the oracle here (the oracle itself is pinned to RFC 9380 vectors in
+test_rfc9380_vectors.py and to the device kernels in test_ops_*).
+"""
+
+import ctypes
+import secrets
+
+import pytest
+
+from lodestar_tpu.crypto.bls import curve as C
+from lodestar_tpu.crypto.bls import pairing as PR
+from lodestar_tpu.crypto.bls.api import PublicKey, Signature, interop_secret_key
+from lodestar_tpu.crypto.bls.fields import Fq2, Fq6, Fq12
+from lodestar_tpu.crypto.bls.hash_to_curve import hash_to_g2
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
+from lodestar_tpu.crypto.bls.verifier import (
+    AggregatedSignatureSet,
+    SingleSignatureSet,
+)
+from lodestar_tpu.native import fastbls
+
+pytestmark = pytest.mark.skipif(
+    not fastbls.have_native(), reason="no C toolchain for fastbls"
+)
+
+
+def _fq12_to_bytes(f: Fq12) -> bytes:
+    comps = []
+    for six in (f.c0, f.c1):
+        for two in (six.c0, six.c1, six.c2):
+            comps += [two.c0, two.c1]
+    return b"".join(c.to_bytes(48, "big") for c in comps)
+
+
+def _signed_set(i: int, msg: bytes):
+    sk = interop_secret_key(i)
+    pk = PublicKey(C.G1_GEN * sk.value)
+    sig = (hash_to_g2(msg) * sk.value)
+    return pk, C.g2_to_bytes(sig)
+
+
+def test_hash_to_g2_matches_oracle():
+    for msg in (b"", b"\x00" * 32, b"abcdef" * 10):
+        got = fastbls.hash_to_g2_affine(msg)
+        exp = hash_to_g2(msg).to_affine()
+        assert got == (exp[0].c0, exp[0].c1, exp[1].c0, exp[1].c1)
+
+
+def test_final_exp_is_one_matches_oracle_verdict():
+    sk = interop_secret_key(5)
+    msg = b"\x05" * 32
+    pk = C.G1_GEN * sk.value
+    h = hash_to_g2(msg)
+    sig = h * sk.value
+    good = PR.miller_loop(pk.to_affine(), h.to_affine()) * PR.miller_loop(
+        (-C.G1_GEN).to_affine(), sig.to_affine()
+    )
+    assert fastbls.final_exp_is_one(_fq12_to_bytes(good)) is True
+    # wrong signature -> not one
+    bad_sig = h * (sk.value + 1)
+    bad = PR.miller_loop(pk.to_affine(), h.to_affine()) * PR.miller_loop(
+        (-C.G1_GEN).to_affine(), bad_sig.to_affine()
+    )
+    assert fastbls.final_exp_is_one(_fq12_to_bytes(bad)) is False
+
+
+def test_fast_verifier_positive_and_negative():
+    v = FastBlsVerifier()
+    assert v.native
+    sets = []
+    for i in range(8):
+        msg = bytes([i]) * 32
+        pk, sig_b = _signed_set(i, msg)
+        sets.append(SingleSignatureSet(pubkey=pk, signing_root=msg, signature=sig_b))
+    assert v.verify_signature_sets(sets)
+    # corrupt one signing root
+    sets[3] = SingleSignatureSet(
+        pubkey=sets[3].pubkey, signing_root=b"\xff" * 32, signature=sets[3].signature
+    )
+    assert not v.verify_signature_sets(sets)
+
+
+def test_fast_verifier_aggregated_set():
+    msg = b"\x42" * 32
+    sks = [interop_secret_key(i) for i in range(3)]
+    pks = [PublicKey(C.G1_GEN * sk.value) for sk in sks]
+    h = hash_to_g2(msg)
+    agg_sig = h * sks[0].value
+    for sk in sks[1:]:
+        agg_sig = agg_sig + h * sk.value
+    s = AggregatedSignatureSet(
+        pubkeys=pks, signing_root=msg, signature=C.g2_to_bytes(agg_sig)
+    )
+    v = FastBlsVerifier()
+    assert v.verify_signature_sets([s])
+    # missing one participant -> invalid
+    s_bad = AggregatedSignatureSet(
+        pubkeys=pks[:2], signing_root=msg, signature=C.g2_to_bytes(agg_sig)
+    )
+    assert not v.verify_signature_sets([s_bad])
+
+
+def test_fast_verifier_rejects_malformed():
+    v = FastBlsVerifier()
+    pk, sig_b = _signed_set(0, b"\x00" * 32)
+    # garbage signature bytes
+    bad = SingleSignatureSet(
+        pubkey=pk, signing_root=b"\x00" * 32, signature=b"\x99" * 96
+    )
+    assert not v.verify_signature_sets([bad])
+    # infinity signature is rejected (eth2 rules)
+    inf = bytes([0xC0]) + b"\x00" * 95
+    assert not v.verify_signature_sets(
+        [SingleSignatureSet(pubkey=pk, signing_root=b"\x00" * 32, signature=inf)]
+    )
+    assert not v.verify_signature_sets([])
+
+
+def test_batch_verify_agreement_with_oracle_batcher():
+    # same sets through the oracle's verify_multiple_signatures and the
+    # native path must agree
+    from lodestar_tpu.crypto.bls.api import verify_multiple_signatures
+
+    triples, packed = [], []
+    for i in range(4):
+        msg = bytes([0x30 + i]) * 32
+        sk = interop_secret_key(i)
+        pk = PublicKey(C.G1_GEN * sk.value)
+        sig_pt = hash_to_g2(msg) * sk.value
+        triples.append((pk, msg, Signature(sig_pt)))
+        packed.append(([pk.to_bytes()], msg, C.g2_to_bytes(sig_pt)))
+    coeffs = [secrets.randbits(64) | 1 for _ in packed]
+    assert verify_multiple_signatures(triples) is True
+    assert fastbls.batch_verify(packed, coeffs) is True
