@@ -5,14 +5,18 @@ Prints ONE JSON line:
 
 Workload: a 128-set batch (MAX_SIGNATURE_SETS_PER_JOB in the reference,
 packages/beacon-node/src/chain/bls/multithread/index.ts:39 — one worker-pool
-job's worth, i.e. a full mainnet block's signature sets) through the batched
-device kernel, measured end-to-end per dispatch (device compute; host
-packing excluded, reported in extras).
+job's worth, i.e. a full mainnet block's signature sets) through the round-4
+SPLIT dispatch: the batched Miller-product kernel on device plus the native
+C final exponentiation on the host (ops/batch_verify.miller_product_kernel
++ csrc/fastbls.c) — the production TpuBlsVerifier path, measured end-to-end
+per dispatch (host packing excluded, reported separately).
 
-Baseline: the measured host-CPU batch-verify path on this machine — the
-pure-Python bigint oracle's verify_multiple_signatures (the reference's
-blst-native C path is not runnable in this image; BASELINE.md records the
-caveat).  vs_baseline = device rate / measured CPU rate.
+Baseline (round-4, VERDICT r3 item 2): the native C batch verifier
+(csrc/fastbls.c, portable 64-bit Montgomery code) measured on THIS host,
+single core — the blst-class CPU path the reference runs behind its worker
+pool.  BASELINE.md records that asm-grade blst is ~3-5x this portable-C
+figure; the pure-Python oracle rate (the old, dishonest denominator) is
+kept in extras for continuity.
 """
 
 from __future__ import annotations
@@ -22,7 +26,7 @@ import os
 import sys
 import time
 
-# Persistent XLA compilation cache: the batched-verify program costs
+# Persistent XLA compilation cache: the batched-verify programs cost
 # minutes of TPU compile cold; the repo-local cache (pre-warmed during the
 # build round, gitignored) brings a driver re-run down to seconds.
 _REPO = os.path.dirname(os.path.abspath(__file__))
@@ -40,30 +44,68 @@ def build_batch(n: int):
     return example_inputs(n)
 
 
-def bench_device(args, repeats: int = 3):
+def bench_split_dispatch(args, repeats: int = 3):
+    """The production path: device Miller product + host C final exp,
+    timed end-to-end (device compute + 2.4KB transfer + host tail)."""
     import jax
 
-    from lodestar_tpu.ops.batch_verify import verify_signature_sets_kernel
+    from lodestar_tpu.crypto.bls.tpu_verifier import TpuBlsVerifier
+    from lodestar_tpu.ops.batch_verify import miller_product_kernel
 
-    fn = jax.jit(verify_signature_sets_kernel)
-    out = fn(*args)  # compile + warm
-    assert bool(out), "benchmark batch failed to verify"
+    fn = jax.jit(miller_product_kernel)
+    v = TpuBlsVerifier()  # host-final-exp helper (no packing here)
+    f, ok = fn(*args)  # compile + warm
+    assert v._host_final_exp_verdict(f, ok), "benchmark batch failed to verify"
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        r = fn(*args)
-        r.block_until_ready()
+        f, ok = fn(*args)
+        f.block_until_ready()
+        verdict = v._host_final_exp_verdict(f, ok)
         times.append(time.perf_counter() - t0)
+        assert verdict
     dt = min(times)
-    return BATCH / dt, dt
+    n = args[0].shape[0]
+    return n / dt, dt
+
+
+def bench_cpu_native(n: int = 128):
+    """Native C batch verify, single core — the honest vs_baseline
+    denominator.  Returns None when the C toolchain is unavailable."""
+    import secrets
+
+    from lodestar_tpu.crypto.bls import curve as C
+    from lodestar_tpu.crypto.bls.api import interop_secret_key
+    from lodestar_tpu.crypto.bls.hash_to_curve import hash_to_g2
+    from lodestar_tpu.native import fastbls
+
+    if not fastbls.have_native():
+        return None
+    packed = []
+    for i in range(n):
+        sk = interop_secret_key(i % 16)
+        msg = bytes([i]) * 32
+        packed.append(
+            (
+                [C.g1_to_bytes(C.G1_GEN * sk.value)],
+                msg,
+                C.g2_to_bytes(hash_to_g2(msg) * sk.value),
+            )
+        )
+    coeffs = [secrets.randbits(64) | 1 for _ in packed]
+    best = None
+    for _ in range(2):
+        t0 = time.perf_counter()
+        ok = fastbls.batch_verify(packed, coeffs)
+        dt = time.perf_counter() - t0
+        assert ok
+        best = dt if best is None else min(best, dt)
+    return n / best
 
 
 def bench_cpu_oracle(n: int = 2):
-    """Oracle (pure python bigint) batch verify throughput per set.
-
-    n=2 keeps the baseline measurement to a couple of bigint pairings
-    (~seconds) — the per-set rate extrapolates linearly and the driver's
-    wall-clock budget belongs to the device measurement."""
+    """Pure-Python bigint oracle rate (extras only — continuity with the
+    r1-r3 denominator)."""
     from lodestar_tpu.crypto.bls.api import (
         interop_secret_key,
         verify_multiple_signatures,
@@ -75,7 +117,7 @@ def bench_cpu_oracle(n: int = 2):
         msg = bytes([i]) * 32
         sets.append((sk.to_public_key(), msg, sk.sign(msg)))
     best = None
-    for _ in range(3):  # best-of-3: a single 2-set run is timing-noisy
+    for _ in range(3):
         t0 = time.perf_counter()
         ok = verify_multiple_signatures(sets)
         dt = time.perf_counter() - t0
@@ -84,12 +126,37 @@ def bench_cpu_oracle(n: int = 2):
     return n / best
 
 
+def bench_small_bucket(n: int = 16, budget_s: float = 120.0):
+    """Dispatch latency for the small gossip bucket (VERDICT r3 weak 10:
+    the latency distribution the node actually feels).  Soft-skipped when
+    the program is not already in the compile cache."""
+    import jax
+
+    from lodestar_tpu.crypto.bls.tpu_verifier import TpuBlsVerifier
+    from lodestar_tpu.ops.batch_verify import miller_product_kernel
+
+    args = build_batch(n)
+    fn = jax.jit(miller_product_kernel)
+    v = TpuBlsVerifier()
+    t0 = time.perf_counter()
+    f, ok = fn(*args)
+    f.block_until_ready()
+    if time.perf_counter() - t0 > budget_s:
+        return None  # cold compile; don't risk the driver's wall clock
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        f, ok = fn(*args)
+        f.block_until_ready()
+        v._host_final_exp_verdict(f, ok)
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
 def bench_dev_chain(time_budget_s: float = 150.0):
     """blocks/s through DevChain.run with the DEVICE verifier — the e2e
     figure (STF + fork choice + batched kernel per block).  Soft-skipped
-    when the kernel for the small bucket is not already in the compile
-    cache (first dispatch over budget) so the driver's wall clock is never
-    at risk."""
+    when the kernel for the bucket is not already in the compile cache."""
     import asyncio
     import time as _t
 
@@ -132,22 +199,28 @@ def bench_dev_chain(time_budget_s: float = 150.0):
 
 def main() -> None:
     args = build_batch(BATCH)
-    dev_rate, dt = bench_device(args)
-    cpu_rate = bench_cpu_oracle()
+    dev_rate, dt = bench_split_dispatch(args)
+    cpu_native = bench_cpu_native()
+    cpu_oracle = bench_cpu_oracle()
+    small_dt = bench_small_bucket()
     chain_rate = bench_dev_chain()
     import jax
 
+    baseline = cpu_native if cpu_native else cpu_oracle
     print(
         json.dumps(
             {
                 "metric": "bls_sig_sets_per_s_per_chip",
                 "value": round(dev_rate, 2),
                 "unit": "sig-sets/s",
-                "vs_baseline": round(dev_rate / cpu_rate, 2),
+                "vs_baseline": round(dev_rate / baseline, 2),
                 "extras": {
                     "batch": BATCH,
                     "dispatch_ms": round(dt * 1e3, 2),
-                    "cpu_baseline_sets_per_s": round(cpu_rate, 3),
+                    "dispatch_ms_bucket16": round(small_dt * 1e3, 2) if small_dt else None,
+                    "cpu_native_sets_per_s": round(cpu_native, 1) if cpu_native else None,
+                    "cpu_oracle_sets_per_s": round(cpu_oracle, 3),
+                    "baseline_kind": "fastbls-c" if cpu_native else "python-oracle",
                     "dev_chain_blocks_per_s": round(chain_rate, 3) if chain_rate else None,
                     "backend": jax.default_backend(),
                 },

@@ -44,30 +44,92 @@ class TpuBlsVerifier:
 
     ``platform=None`` uses the default JAX backend (TPU when present);
     tests pin ``platform='cpu'``.
+
+    Round-4 split dispatch (``host_final_exp=True``, the default): the
+    device runs only the batch-parallel stages and returns the Miller
+    product; the host finishes with the native C final exponentiation
+    (csrc/fastbls.c — ~2 ms vs ~145 ms of serial device scan latency;
+    see ops/batch_verify.miller_product_kernel).  The pure-Python oracle
+    is the automatic fallback when the C toolchain is absent, and
+    ``host_final_exp=False`` restores the single fused device program.
+
+    Multi-device scale-out (``devices=[...]``): the batch axis is sharded
+    over a 1-D jax.sharding.Mesh, the ICI data-parallel story of SURVEY
+    §2.10 item 1 — production dispatch, not just the dryrun demo.  Buckets
+    that don't divide evenly fall back to single-device dispatch.
     """
 
-    def __init__(self, buckets: Sequence[int] = DEFAULT_BUCKETS, platform: Optional[str] = None):
+    def __init__(
+        self,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        platform: Optional[str] = None,
+        devices: Optional[Sequence] = None,
+        host_final_exp: bool = True,
+    ):
         self.buckets = tuple(sorted(buckets))
         self.platform = platform
+        self.devices = list(devices) if devices else None
+        self.host_final_exp = host_final_exp
         self._compiled = {}
         # pool-style counters (metrics parity with blsThreadPool.*,
         # metrics/metrics/lodestar.ts:385)
         self.dispatches = 0
         self.sets_verified = 0
         self.padding_wasted = 0
+        self.host_final_exps = 0
 
     # -- compilation cache ---------------------------------------------------
 
     def _fn(self, n: int):
-        if n not in self._compiled:
+        key = (n, self.host_final_exp)
+        if key not in self._compiled:
             import jax
 
-            fn = jax.jit(bv.verify_signature_sets_kernel)
-            if self.platform is not None:
+            kernel = (
+                bv.miller_product_kernel if self.host_final_exp
+                else bv.verify_signature_sets_kernel
+            )
+            if self.devices and len(self.devices) > 1 and n % len(self.devices) == 0:
+                from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+                mesh = Mesh(np.array(self.devices), ("sets",))
+                batch = NamedSharding(mesh, PartitionSpec("sets"))
+                fn = jax.jit(kernel, in_shardings=(batch,) * 7)
+            elif self.platform is not None:
                 device = jax.devices(self.platform)[0]
-                fn = jax.jit(bv.verify_signature_sets_kernel, device=device)
-            self._compiled[n] = fn
-        return self._compiled[n]
+                fn = jax.jit(kernel, device=device)
+            else:
+                fn = jax.jit(kernel)
+            self._compiled[key] = fn
+        return self._compiled[key]
+
+    def _host_final_exp_verdict(self, f_digits, ok) -> bool:
+        """Reduce the device Miller product to canonical bytes and run the
+        final exponentiation + is-one check on the host (native C first,
+        bigint oracle as fallback)."""
+        if not bool(ok):
+            return False
+        self.host_final_exps += 1
+        f = np.asarray(f_digits, dtype=np.float64)  # (6, 2, 50)
+        comps = []
+        for i in range(6):
+            for j in range(2):
+                comps.append(fl.limbs_to_int(f[i, j]) % fl.P_INT)
+        blob = b"".join(c.to_bytes(48, "big") for c in comps)
+        from ...native import fastbls
+
+        out = fastbls.final_exp_is_one(blob)
+        if out is not None:
+            return bool(out)
+        # oracle fallback: same verdict via bigint final exponentiation
+        from .fields import Fq2, Fq6, Fq12
+        from .pairing import final_exponentiation
+
+        fq12 = Fq12(
+            Fq6(Fq2(*comps[0:2]), Fq2(*comps[2:4]), Fq2(*comps[4:6])),
+            Fq6(Fq2(*comps[6:8]), Fq2(*comps[8:10]), Fq2(*comps[10:12])),
+        )
+        return final_exponentiation(fq12).is_one()
 
     def _bucket(self, n: int) -> int:
         for b in self.buckets:
@@ -92,6 +154,9 @@ class TpuBlsVerifier:
             return False  # malformed bytes / infinity inputs
         self.dispatches += 1
         self.sets_verified += len(sets)
+        if self.host_final_exp:
+            f, ok = self._fn(packed[0].shape[0])(*packed)
+            return self._host_final_exp_verdict(f, ok)
         out = self._fn(packed[0].shape[0])(*packed)
         return bool(out)
 

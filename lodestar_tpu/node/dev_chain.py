@@ -50,6 +50,7 @@ class DevChain:
         genesis_time: int = 0,
         metrics=None,
         db=None,
+        execution_engine=None,
     ):
         self.p = preset
         self.cfg = cfg
@@ -63,7 +64,10 @@ class DevChain:
         self.clock = ManualClock(
             genesis_time or 1, cfg.SECONDS_PER_SLOT, preset.SLOTS_PER_EPOCH
         )
-        self.chain = BeaconChain(preset, cfg, genesis, bls_pool, db=db, metrics=metrics, clock=self.clock)
+        self.chain = BeaconChain(
+            preset, cfg, genesis, bls_pool, db=db, metrics=metrics,
+            clock=self.clock, execution_engine=execution_engine,
+        )
         self.pending_attestations: List = []
 
     # -- inline validator duties (validator/src/services analogs) -------------

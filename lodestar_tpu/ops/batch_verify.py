@@ -96,6 +96,64 @@ def verify_signature_sets_kernel(
     return product_one & subgroup_ok & jnp.any(mask)
 
 
+def miller_product_kernel(
+    pk_x: jnp.ndarray,
+    pk_y: jnp.ndarray,
+    sig_x: jnp.ndarray,
+    sig_y: jnp.ndarray,
+    msg_u: jnp.ndarray,
+    coeff_bits: jnp.ndarray,
+    mask: jnp.ndarray,
+) -> tuple:
+    """The SPLIT dispatch: stages 1-4 plus the batched Miller product —
+    everything batch-parallel — returning the un-final-exponentiated Fq12
+    product for the HOST to finish (csrc/fastbls.c fb_final_exp_is_one).
+
+    Rationale (round-4 latency work): after the product tree the batch
+    axis is gone; the final exponentiation is ~320 serial Fq12 ops on ONE
+    tiny (6,2,50) tensor, pure scan latency the TPU cannot amortize
+    (round-3 profile: ~145 ms of the 575 ms dispatch).  The host C core
+    does the same exponentiation in ~2 ms.  Splitting keeps every
+    batch-wide stage on device and moves only a 2.4 KB tensor + the serial
+    tail to the host.  Verdicts are identical: both paths compute
+    f^(3*lambda) and compare against 1.
+
+    Returns (f, ok) with f: (6, 2, 50) digits of the masked Miller
+    product and ok: scalar bool (subgroup checks passed AND any live lane).
+    """
+    n = pk_x.shape[0]
+
+    sig_jac = pts.point_from_affine(sig_x, sig_y, FQ2_NS)
+    sig_in_g2 = pts.g2_subgroup_check(sig_jac)
+    subgroup_ok = jnp.all(jnp.where(mask, sig_in_g2, True))
+
+    h_jac = htc.hash_to_g2_device(msg_u)
+
+    pk_jac = pts.point_from_affine(pk_x, pk_y, FQ_NS)
+    pk_scaled = pts.point_mul_bits(pk_jac, coeff_bits, FQ_NS)
+    sig_scaled = pts.point_mul_bits(sig_jac, coeff_bits, FQ2_NS)
+
+    inf = pts.point_infinity(FQ2_NS, batch_shape=(n,))
+    sig_masked = pts.point_select(mask, sig_scaled, inf, FQ2_NS)
+    s_sum = pts.point_sum_tree(sig_masked, FQ2_NS)
+
+    g2_stack = tuple(
+        jnp.concatenate([h_jac[i], s_sum[i][None]], axis=0) for i in range(3)
+    )
+    g2_aff_x, g2_aff_y = pts.point_to_affine(g2_stack, FQ2_NS)
+    pk_aff_x, pk_aff_y = pts.point_to_affine(pk_scaled, FQ_NS)
+
+    neg_g1_x = jnp.asarray(pts.G1_GEN_NEG_AFFINE[0])
+    neg_g1_y = jnp.asarray(pts.G1_GEN_NEG_AFFINE[1])
+    xp = jnp.concatenate([pk_aff_x, neg_g1_x[None]], axis=0)
+    yp = jnp.concatenate([pk_aff_y, neg_g1_y[None]], axis=0)
+    s_not_inf = ~tw.fq2_is_zero(s_sum[2])
+    pair_mask = jnp.concatenate([mask, s_not_inf[None]], axis=0)
+
+    f = kp.multi_miller_product(xp, yp, g2_aff_x, g2_aff_y, pair_mask)
+    return f, subgroup_ok & jnp.any(mask)
+
+
 def example_inputs(n: int = 8) -> tuple:
     """Deterministic, well-formed example inputs (numpy only — safe to build
     without touching any JAX backend).  Used by __graft_entry__ and bench."""
