@@ -189,6 +189,10 @@ class RestApiServer:
         r("POST", "/eth/v1/validator/contribution_and_proofs", self._submit_contributions)
         r("GET", "/eth/v1/beacon/light_client/bootstrap/{block_root}", self._lc_bootstrap)
         r("GET", "/eth/v1/beacon/light_client/updates", self._lc_updates)
+        # debug namespace (routes/debug.ts): SSZ state download — the
+        # checkpoint-sync server side (initBeaconState.ts fetches this)
+        r("GET", "/eth/v2/debug/beacon/states/{state_id}", self._debug_state)
+        r("GET", "/eth/v2/beacon/blocks/{block_id}", self._block_ssz)
         r("GET", "/metrics", self._metrics)
 
     def _state_for(self, state_id: str):
@@ -211,6 +215,41 @@ class RestApiServer:
                 raise ApiError(404, "state not found")
             return st
         raise ApiError(400, f"unsupported state id {state_id}")
+
+    def _debug_state(self, pp, q, b):
+        """Fork-tagged SSZ state (1 tag byte + SSZ — the same codec the db
+        uses; clients of this framework decode with it)."""
+        from ..db.beacon import _fork_tagged_state_codec
+
+        state = self._state_for(pp["state_id"])
+        enc, _dec = _fork_tagged_state_codec(self.p)
+        return enc(state), "application/octet-stream"
+
+    def _block_for(self, block_id: str):
+        chain = self.chain
+        if block_id == "head":
+            blk = chain.get_block_by_root(chain.head_root)
+        elif block_id in ("justified", "finalized"):
+            cp = (
+                chain.fork_choice.store.justified_checkpoint
+                if block_id == "justified"
+                else chain.fork_choice.store.finalized_checkpoint
+            )
+            blk = chain.get_block_by_root(cp.root)
+        elif block_id.startswith("0x"):
+            blk = chain.get_block_by_root(bytes.fromhex(block_id[2:]))
+        else:
+            raise ApiError(400, f"unsupported block id {block_id}")
+        if blk is None:
+            raise ApiError(404, f"block {block_id} not found")
+        return blk
+
+    def _block_ssz(self, pp, q, b):
+        from ..db.beacon import _fork_tagged_block_codec
+
+        blk = self._block_for(pp["block_id"])
+        enc, _dec = _fork_tagged_block_codec(self.p)
+        return enc(blk), "application/octet-stream"
 
     def _syncing(self, pp, q, b):
         head_slot = self.chain.head_state().slot

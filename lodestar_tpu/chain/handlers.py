@@ -50,6 +50,10 @@ class GossipHandlers:
         self.seen_aggregators = SeenAggregators()
         self.seen_aggregates = SeenAggregatedAttestations()
         self.seen_proposers = SeenBlockProposers()
+        from .seen_cache import SeenContributions, SeenSyncCommitteeMessages
+
+        self.seen_sync_msgs = SeenSyncCommitteeMessages()
+        self.seen_contributions = SeenContributions()
 
     # -- helpers ---------------------------------------------------------------
 
@@ -167,3 +171,30 @@ class GossipHandlers:
             pool=self.chain.bls, op_pool=self.chain.op_pool,
         )
         self.chain.op_pool.add_attester_slashing(slashing)
+
+    # -- altair sync-committee traffic (gossipHandlers.ts syncCommittee*) ------
+
+    async def on_sync_committee_message(self, message, subnet: int) -> None:
+        from .sync_committee_pools import validate_sync_committee_message
+
+        ctx, state = self._head_ctx_state(self._clock_slot())
+        index_in_sub = await validate_sync_committee_message(
+            self.p, self.cfg, message=message, subnet=subnet,
+            clock_slot=self._clock_slot(), state=state, ctx=ctx,
+            seen_sync_msgs=self.seen_sync_msgs, pool=self.chain.bls,
+        )
+        self.chain.sync_msg_pool.add(
+            message.slot, bytes(message.beacon_block_root), subnet,
+            index_in_sub, bytes(message.signature),
+        )
+
+    async def on_sync_contribution(self, signed_contribution) -> None:
+        from .sync_committee_pools import validate_sync_committee_contribution
+
+        ctx, state = self._head_ctx_state(self._clock_slot())
+        await validate_sync_committee_contribution(
+            self.p, self.cfg, signed_contribution=signed_contribution,
+            clock_slot=self._clock_slot(), state=state, ctx=ctx,
+            seen_contributions=self.seen_contributions, pool=self.chain.bls,
+        )
+        self.chain.contribution_pool.add(signed_contribution.message.contribution)

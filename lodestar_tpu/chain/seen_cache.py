@@ -115,6 +115,30 @@ class SeenSyncCommitteeMessages:
                     del self._by_slot[s]
 
 
+class SeenContributions:
+    """Slot-keyed first-seen set for (slot, aggregator, subcommittee)
+    contribution keys (seenContributionAndProof.ts) with the same bounded
+    retention as SeenSyncCommitteeMessages — an unbounded set would leak
+    one entry per contribution for the node's whole uptime."""
+
+    def __init__(self, retention_slots: int = 8):
+        self._by_slot: Dict[int, Set[tuple]] = {}
+        self._max_slot = 0
+        self.retention = retention_slots
+
+    def __contains__(self, key: tuple) -> bool:
+        return key in self._by_slot.get(int(key[0]), ())
+
+    def add(self, key: tuple) -> None:
+        slot = int(key[0])
+        self._by_slot.setdefault(slot, set()).add(key)
+        if slot > self._max_slot:
+            self._max_slot = slot
+            for s in list(self._by_slot):
+                if s < self._max_slot - self.retention:
+                    del self._by_slot[s]
+
+
 class SeenBlockAttesters(SeenEpochValidators):
     """Validators whose attestations appeared in blocks — liveness data for
     the doppelganger check (seenBlockAttesters.ts)."""

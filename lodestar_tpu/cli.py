@@ -74,6 +74,11 @@ def build_parser() -> argparse.ArgumentParser:
     beacon = sub.add_parser("beacon", help="beacon node (cmds/beacon)")
     common(beacon)
     beacon.add_argument("--genesis-state", help="SSZ genesis state file")
+    beacon.add_argument(
+        "--checkpoint-sync-url",
+        help="trusted beacon REST URL to fetch the finalized state from "
+        "(initBeaconState.ts:104-136); backfill then earns history backwards",
+    )
 
     vc = sub.add_parser("validator", help="validator client (cmds/validator)")
     vc.add_argument("--beacon-url", default="http://127.0.0.1:9596")
@@ -193,7 +198,16 @@ async def run_beacon(args) -> int:
     cfg = _chain_config(args)
     controller = SqliteDbController(args.db) if args.db else MemoryDbController()
     db = BeaconDb(preset, controller)
-    if args.genesis_state:
+    anchor_block_root = None
+    if args.checkpoint_sync_url:
+        from .node.checkpoint_sync import fetch_checkpoint_state
+
+        genesis, anchor_block, anchor_block_root = await fetch_checkpoint_state(
+            preset, cfg, args.checkpoint_sync_url
+        )
+        db.block.put(anchor_block_root, anchor_block)
+        db.archive_block(anchor_block, anchor_block_root)
+    elif args.genesis_state:
         from .types import get_types
 
         raw = open(args.genesis_state, "rb").read()
@@ -213,8 +227,19 @@ async def run_beacon(args) -> int:
     rest = RestApiServer(preset, chain, network=network)
     rest.gossip_handlers = handlers
     await rest.listen(args.rest_port)
-    sync = RangeSync(preset, chain, network.peer_manager)
+    backfill_task = None
+    if anchor_block_root is not None:
+        from .sync.backfill import BackfillSync
+
+        backfill = BackfillSync(
+            preset, cfg, db, pool, genesis, anchor_block_root, network.peer_manager
+        )
+        backfill_task = asyncio.create_task(backfill.run())
+    sync = RangeSync(preset, chain, network.peer_manager, report_peer=network.report_peer)
     imported = await sync.run_to_head()
+    if backfill_task is not None:
+        stored = await backfill_task
+        logger.info("backfill stored %d historical blocks", stored)
     logger.info("synced %d blocks; following gossip (ctrl-c to stop)", imported)
     try:
         while True:

@@ -25,6 +25,12 @@ TOPIC_ATTESTATION = "beacon_attestation_{subnet}"
 TOPIC_EXIT = "voluntary_exit"
 TOPIC_PROPOSER_SLASHING = "proposer_slashing"
 TOPIC_ATTESTER_SLASHING = "attester_slashing"
+# altair sync-committee traffic (gossip/interface.ts, topic.ts)
+TOPIC_SYNC_CONTRIBUTION = "sync_committee_contribution_and_proof"
+TOPIC_SYNC_COMMITTEE = "sync_committee_{subnet}"
+
+ATTESTATION_SUBNET_COUNT = 64
+SYNC_COMMITTEE_SUBNET_COUNT = 4
 
 
 def topic_string(fork_digest: bytes, name: str) -> str:
@@ -64,10 +70,14 @@ class GossipRouter:
     to peers.  Transport-agnostic: `send_fns` are per-peer async callables
     (topic, ssz_bytes) -> None registered by the Network."""
 
-    def __init__(self):
+    def __init__(self, on_reject: Optional[Callable[[str, str], None]] = None):
         self.subscriptions: Dict[str, Callable[[bytes], Awaitable[None]]] = {}
         self.seen = SeenMessages()
         self.send_fns: List[Callable[[str, bytes], Awaitable[None]]] = []
+        # called as (peer_key, code) when a peer's message is REJECTed —
+        # the hook the PeerRpcScoreStore hangs off (scoringParameters.ts
+        # invalid-message penalties reduced to their effect)
+        self.on_reject = on_reject
 
     def subscribe(self, topic: str, handler: Callable[[bytes], Awaitable[None]]) -> None:
         self.subscriptions[topic] = handler
@@ -92,23 +102,32 @@ class GossipRouter:
                 logger.warning("gossip publish to peer failed: %s", e)
         return n
 
-    async def on_message(self, topic: str, ssz_bytes: bytes, *, forward: bool = True) -> None:
-        """Inbound message: dedup -> local handler -> re-flood (the
-        IGNORE/REJECT semantics live in the handler: it raises
-        GossipValidationError and we drop without forwarding)."""
+    async def on_message(
+        self, topic: str, ssz_bytes: bytes, *, forward: bool = True,
+        from_peer: Optional[str] = None,
+    ) -> None:
+        """Inbound message: dedup -> local handler -> re-flood.  IGNORE
+        drops silently; REJECT drops AND reports the sending peer to the
+        score store via on_reject (an invalid message is provable
+        misbehavior; a merely-late one is not)."""
         if not self.seen.check_and_add(message_id(topic, ssz_bytes)):
             return
         handler = self.subscriptions.get(topic)
         if handler is None:
             return
-        from ..chain.validation import GossipValidationError
+        from ..chain.validation import GossipAction, GossipValidationError
 
         try:
             await handler(ssz_bytes)
         except GossipValidationError as e:
             logger.debug("gossip %s: %s", topic, e)
+            if e.action == GossipAction.REJECT and from_peer and self.on_reject:
+                self.on_reject(from_peer, e.code)
             return  # IGNORE and REJECT both stop propagation here
         except Exception as e:  # noqa: BLE001
+            # a local handler bug or transient state miss is OUR problem —
+            # penalizing the relaying peer for it would let a local fault
+            # ban the entire peer set (review r4); only REJECT downscores
             logger.warning("gossip handler error on %s: %s", topic, e)
             return
         if forward:

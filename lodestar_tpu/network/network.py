@@ -14,17 +14,27 @@ from ..params import Preset
 from ..types import get_types
 from ..utils.logger import get_logger
 from .gossip import (
+    ATTESTATION_SUBNET_COUNT,
+    SYNC_COMMITTEE_SUBNET_COUNT,
     TOPIC_AGGREGATE,
     TOPIC_ATTESTATION,
     TOPIC_ATTESTER_SLASHING,
     TOPIC_BLOCK,
     TOPIC_EXIT,
     TOPIC_PROPOSER_SLASHING,
+    TOPIC_SYNC_COMMITTEE,
+    TOPIC_SYNC_CONTRIBUTION,
     GossipRouter,
     parse_topic,
     topic_string,
 )
-from .peer import Peer, PeerManager
+from .peer import (
+    Peer,
+    PeerAction,
+    PeerManager,
+    PeerRpcScoreStore,
+    ScoreState,
+)
 from .reqresp import ReqRespNode
 from .wire import KIND_GOSSIP, KIND_REQUEST, KIND_RESPONSE_CHUNK, KIND_RESPONSE_END, Wire
 
@@ -40,7 +50,8 @@ class Network:
         self.host = host
         self.port: Optional[int] = None
         self.peer_manager = PeerManager()
-        self.router = GossipRouter()
+        self.score_store = PeerRpcScoreStore()
+        self.router = GossipRouter(on_reject=self._on_gossip_reject)
         self._server: Optional[asyncio.AbstractServer] = None
         self._peer_seq = 0
         self.t = get_types(preset).phase0
@@ -69,14 +80,30 @@ class Network:
     # -- connection plumbing ---------------------------------------------------
 
     async def _on_inbound(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
-        await self._setup_peer(reader, writer, initiator=False)
+        try:
+            await self._setup_peer(reader, writer, initiator=False)
+        except ConnectionRefusedError as e:
+            logger.debug("inbound connection refused: %s", e)
 
     async def _setup_peer(self, reader, writer, *, initiator: bool) -> Peer:
         self._peer_seq += 1
         peer_id = f"peer-{id(self) & 0xFFFF:x}-{self._peer_seq}"
+        try:
+            # score identity = remote HOST for both directions: inbound
+            # source ports are ephemeral, and a split host:port/host keying
+            # would let a banned outbound peer re-enter inbound (review r4).
+            # IP-granular banning (with its NAT collateral) matches the
+            # reference's IP ban list.
+            remote_key = str(writer.get_extra_info("peername")[0])
+        except Exception:
+            remote_key = peer_id
+        # banned identities are refused at the door (peers/score.ts ban)
+        if self.score_store.state(remote_key) == ScoreState.BANNED:
+            writer.close()
+            raise ConnectionRefusedError(f"peer {remote_key} is banned")
         wire = Wire(reader, writer)
         reqresp = ReqRespNode(self.p, self.chain, wire)
-        peer = Peer(peer_id=peer_id, reqresp=reqresp, wire=wire)
+        peer = Peer(peer_id=peer_id, reqresp=reqresp, wire=wire, remote_key=remote_key)
 
         async def gossip_send(topic: str, ssz_bytes: bytes) -> None:
             await wire.send_frame(KIND_GOSSIP, Wire.encode_gossip(topic, ssz_bytes))
@@ -104,7 +131,8 @@ class Network:
                     topic, data = Wire.decode_gossip(payload)
                     if self.metrics:
                         self.metrics.gossip_messages_total.labels(dir="rx").inc()
-                    await self.router.on_message(topic, data)
+                    await self.router.on_message(topic, data, from_peer=peer.remote_key)
+                    await self._enforce_score(peer)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         except Exception as e:  # noqa: BLE001
@@ -126,6 +154,25 @@ class Network:
             if t is not asyncio.current_task():
                 t.cancel()
 
+    # -- peer scoring (peers/score.ts enforcement) -----------------------------
+
+    def _on_gossip_reject(self, peer_key: str, code: str) -> None:
+        """Router callback: an invalid (REJECT) gossip message is provable
+        misbehavior — downscore the sender."""
+        self.score_store.apply_action(peer_key, PeerAction.LOW_TOLERANCE, f"gossip:{code}")
+
+    async def report_peer(self, peer: Peer, action: PeerAction, reason: str = "") -> None:
+        """Apply a score action and enforce the resulting state (the
+        reqresp/sync entry point: bad blocks, garbage responses...)."""
+        self.score_store.apply_action(peer.remote_key, action, reason)
+        await self._enforce_score(peer)
+
+    async def _enforce_score(self, peer: Peer) -> None:
+        state = self.score_store.state(peer.remote_key)
+        if state != ScoreState.HEALTHY and self.peer_manager.get(peer.peer_id) is not None:
+            logger.info("dropping peer %s (%s)", peer.peer_id, state.value)
+            await self._drop_peer(peer, goodbye=True)
+
     # -- gossip binding --------------------------------------------------------
 
     def _fork_digest(self) -> bytes:
@@ -138,8 +185,26 @@ class Network:
 
     def _subscribe_core_topics(self) -> None:
         """Bind the spec topics to the chain's gossip handlers with SSZ
-        decode at the boundary (gossipsub.ts topic handler table)."""
-        digest = self._fork_digest()
+        decode at the boundary (gossipsub.ts topic handler table).  Topics
+        are registered under EVERY fork digest in the schedule — the
+        in-process analog of forks.ts's subscribe-2-epochs-ahead: messages
+        for a past or future fork's digest resolve to the same handlers."""
+        for digest in self._all_fork_digests():
+            self._subscribe_topics_for_digest(digest)
+
+    def _all_fork_digests(self):
+        from ..state_transition import compute_fork_digest
+
+        state = self.chain.head_state()
+        gvr = bytes(state.genesis_validators_root)
+        digests = []
+        for info in self.chain.fork_config.forks_ascending:
+            d = compute_fork_digest(self.p, info.version, gvr)
+            if d not in digests:
+                digests.append(d)
+        return digests
+
+    def _subscribe_topics_for_digest(self, digest: bytes) -> None:
         h = self.handlers
         t = self.t
 
@@ -167,13 +232,31 @@ class Network:
         self.router.subscribe(topic_string(digest, TOPIC_EXIT), on_exit)
         self.router.subscribe(topic_string(digest, TOPIC_PROPOSER_SLASHING), on_prop_slashing)
         self.router.subscribe(topic_string(digest, TOPIC_ATTESTER_SLASHING), on_att_slashing)
-        for subnet in range(4):  # attestation subnets (subset; attnets v1)
+        for subnet in range(ATTESTATION_SUBNET_COUNT):  # all 64 (topic.ts)
             topic = topic_string(digest, TOPIC_ATTESTATION.format(subnet=subnet))
 
             async def on_att(data: bytes, _subnet=subnet) -> None:
                 await h.on_attestation(t.Attestation.deserialize(data), subnet=_subnet)
 
             self.router.subscribe(topic, on_att)
+
+        # altair sync-committee topics (gossip/interface.ts): the
+        # contribution topic plus the 4 per-subnet message topics
+        alt = get_types(self.p).altair
+
+        async def on_contribution(data: bytes) -> None:
+            await h.on_sync_contribution(alt.SignedContributionAndProof.deserialize(data))
+
+        self.router.subscribe(topic_string(digest, TOPIC_SYNC_CONTRIBUTION), on_contribution)
+        for subnet in range(SYNC_COMMITTEE_SUBNET_COUNT):
+            topic = topic_string(digest, TOPIC_SYNC_COMMITTEE.format(subnet=subnet))
+
+            async def on_sync_msg(data: bytes, _subnet=subnet) -> None:
+                await h.on_sync_committee_message(
+                    alt.SyncCommitteeMessage.deserialize(data), subnet=_subnet
+                )
+
+            self.router.subscribe(topic, on_sync_msg)
 
     # -- publish helpers (network.ts publishBeaconBlock etc.) ------------------
 
@@ -197,3 +280,16 @@ class Network:
     async def publish_voluntary_exit(self, signed_exit) -> int:
         data = self.t.SignedVoluntaryExit.serialize(signed_exit)
         return await self.router.publish(topic_string(self._fork_digest(), TOPIC_EXIT), data)
+
+    async def publish_sync_committee_message(self, message, subnet: int) -> int:
+        data = get_types(self.p).altair.SyncCommitteeMessage.serialize(message)
+        return await self.router.publish(
+            topic_string(self._fork_digest(), TOPIC_SYNC_COMMITTEE.format(subnet=subnet)),
+            data,
+        )
+
+    async def publish_sync_contribution(self, signed_contribution) -> int:
+        data = get_types(self.p).altair.SignedContributionAndProof.serialize(signed_contribution)
+        return await self.router.publish(
+            topic_string(self._fork_digest(), TOPIC_SYNC_CONTRIBUTION), data
+        )

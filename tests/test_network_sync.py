@@ -112,3 +112,71 @@ def test_gossip_block_propagation_and_unknown_parent():
         pool_b.close()
 
     asyncio.run(main())
+
+
+def test_range_sync_survives_garbage_peer():
+    """VERDICT r3 item 10 done-criterion: one peer serves garbage blocks,
+    sync completes from the honest peer and the bad one is downscored."""
+
+    async def main():
+        a, b, pool_a, pool_b = make_pair()
+        pool_c = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        c = DevChain(MINIMAL, CFG, N, pool_c)  # the syncing node
+        await a.run(MINIMAL.SLOTS_PER_EPOCH + 4, with_attestations=False)
+        # b mirrors a's chain so it can serve the same canonical blocks
+        for slot in range(1, MINIMAL.SLOTS_PER_EPOCH + 5):
+            root = a.chain.fork_choice.proto.get_ancestor(a.chain.head_root, slot)
+            blk = a.chain.get_block_by_root(root) if root else None
+            if blk is not None and blk.message.slot == slot:
+                b.clock.set_slot(slot)
+                await b.chain.process_block(blk)
+        assert b.chain.head_root == a.chain.head_root
+
+        net_a = Network(MINIMAL, a.chain, GossipHandlers(a.chain))
+        net_b = Network(MINIMAL, b.chain, GossipHandlers(b.chain))
+        net_c = Network(MINIMAL, c.chain, GossipHandlers(c.chain))
+        port_a = await net_a.listen(0)
+        port_b = await net_b.listen(0)
+        peer_honest = await net_c.connect("127.0.0.1", port_a)
+        peer_bad = await net_c.connect("127.0.0.1", port_b)
+
+        # sabotage the BAD peer's serving side: blocks arrive corrupted
+        orig = peer_bad.reqresp.blocks_by_range
+
+        async def garbage(start, count, step=1):
+            blocks = await orig(start, count, step)
+            for blk in blocks:
+                blk.message.state_root = b"\xde\xad" * 16  # breaks import
+            return blocks
+
+        peer_bad.reqresp.blocks_by_range = garbage
+        # make the bad peer look strictly better so it is tried first
+        peer_bad.status = Fields(
+            fork_digest=peer_bad.status.fork_digest,
+            finalized_root=peer_bad.status.finalized_root,
+            finalized_epoch=peer_bad.status.finalized_epoch,
+            head_root=peer_bad.status.head_root,
+            head_slot=peer_bad.status.head_slot + 1,
+        )
+
+        reports = []
+
+        async def report(peer, action, reason):
+            reports.append((peer.peer_id, action))
+
+        sync = RangeSync(MINIMAL, c.chain, net_c.peer_manager, report_peer=report)
+        imported = await sync.run_to_head()
+        assert imported > 0
+        assert c.chain.head_root == a.chain.head_root
+        assert any(pid == peer_bad.peer_id for pid, _ in reports), (
+            "garbage peer was not downscored"
+        )
+
+        await net_c.close()
+        await net_b.close()
+        await net_a.close()
+        pool_a.close()
+        pool_b.close()
+        pool_c.close()
+
+    asyncio.run(main())

@@ -1,0 +1,183 @@
+"""Sync-committee traffic over the wire + peer-score enforcement.
+
+VERDICT r3 item 6 done-criteria: (a) a two-node test where altair sync
+messages/contributions cross the wire into the receiving node's pools,
+(b) a misbehaving peer (invalid gossip -> REJECT) is downscored and
+disconnected.  Reference: gossip/interface.ts sync-committee topics,
+peers/score.ts enforcement.
+"""
+
+import asyncio
+
+from lodestar_tpu.chain.bls_pool import BlsBatchPool
+from lodestar_tpu.chain.handlers import GossipHandlers
+from lodestar_tpu.chain.sync_committee_pools import (
+    SYNC_COMMITTEE_SUBNET_COUNT,
+    subcommittee_assignment,
+)
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.network import Network
+from lodestar_tpu.network.peer import (
+    MIN_SCORE_BEFORE_BAN,
+    PeerAction,
+    PeerRpcScoreStore,
+    ScoreState,
+)
+from lodestar_tpu.node.dev_chain import DevChain
+from lodestar_tpu.params import DOMAIN_SYNC_COMMITTEE, MINIMAL
+from lodestar_tpu.ssz import Fields
+from lodestar_tpu.state_transition import compute_epoch_at_slot
+from lodestar_tpu.state_transition.domain import get_domain
+from lodestar_tpu.types import get_types
+
+# altair from genesis-ish: fork at epoch 1 so sync committees exist early
+CFG = ChainConfig(
+    PRESET_BASE="minimal", SHARD_COMMITTEE_PERIOD=0, MIN_GENESIS_TIME=0,
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
+    ALTAIR_FORK_EPOCH=1, BELLATRIX_FORK_EPOCH=2**64 - 1,
+)
+N = 16
+
+
+async def wait_until(cond, timeout=20.0, interval=0.1):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if cond():
+            return True
+        await asyncio.sleep(interval)
+    return cond()
+
+
+def make_pair():
+    pool_a = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+    pool_b = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+    a = DevChain(MINIMAL, CFG, N, pool_a)
+    b = DevChain(MINIMAL, CFG, N, pool_b)
+    return a, b, pool_a, pool_b
+
+
+def _sign_sync_message(dev, state, slot: int, vi: int):
+    """A real SyncCommitteeMessage from interop validator `vi` over the
+    head root (validator/services/syncCommittee.ts collapsed)."""
+    t = get_types(MINIMAL)
+    epoch = compute_epoch_at_slot(MINIMAL, slot)
+    domain = get_domain(MINIMAL, state, DOMAIN_SYNC_COMMITTEE, epoch)
+    root = t.phase0.SigningData.hash_tree_root(
+        Fields(object_root=dev.chain.head_root, domain=domain)
+    )
+    sig = dev.keys[vi].sign(root)
+    return Fields(
+        slot=slot,
+        beacon_block_root=dev.chain.head_root,
+        validator_index=vi,
+        signature=sig.to_bytes(),
+    )
+
+
+def test_sync_committee_messages_cross_the_wire():
+    async def main():
+        a, b, pool_a, pool_b = make_pair()
+        # both chains advance into altair together
+        for slot in range(1, 10):
+            blk = await a.produce_and_import_block(slot)
+            b.clock.set_slot(slot)
+            await b.chain.process_block(blk)
+        assert a.chain.head_root == b.chain.head_root
+
+        net_a = Network(MINIMAL, a.chain, GossipHandlers(a.chain))
+        net_b = Network(MINIMAL, b.chain, GossipHandlers(b.chain))
+        port = await net_a.listen(0)
+        await net_b.connect("127.0.0.1", port)
+
+        slot = 9
+        state = b.chain.head_state()
+        # pick a validator and its actual subnet
+        vi = 0
+        subs = subcommittee_assignment(MINIMAL, state, vi)
+        assert subs, "interop validator 0 must sit in the sync committee"
+        subnet = subs[0]
+        msg = _sign_sync_message(b, state, slot, vi)
+        # B publishes on the per-subnet topic; A validates into its pool
+        n_sent = await net_b.publish_sync_committee_message(msg, subnet=subnet)
+        assert n_sent == 1
+        # validation runs through the bigint oracle (~100s of ms); poll
+        assert await wait_until(
+            lambda: net_a.chain.sync_msg_pool.get_contribution(
+                slot, a.chain.head_root, subnet
+            )
+            is not None
+        ), "message did not reach A's pool"
+        contrib = net_a.chain.sync_msg_pool.get_contribution(slot, a.chain.head_root, subnet)
+        assert any(contrib.aggregation_bits)
+
+        await net_b.close()
+        await net_a.close()
+        pool_a.close()
+        pool_b.close()
+
+    asyncio.run(main())
+
+
+def test_invalid_gossip_downscores_and_disconnects():
+    async def main():
+        a, b, pool_a, pool_b = make_pair()
+        for slot in range(1, 10):
+            blk = await a.produce_and_import_block(slot)
+            b.clock.set_slot(slot)
+            await b.chain.process_block(blk)
+
+        net_a = Network(MINIMAL, a.chain, GossipHandlers(a.chain))
+        net_b = Network(MINIMAL, b.chain, GossipHandlers(b.chain))
+        port = await net_a.listen(0)
+        await net_b.connect("127.0.0.1", port)
+        assert len(net_a.peer_manager.peers) == 1
+
+        # B floods A with sync messages carrying garbage signatures from a
+        # validator NOT in the right subnet -> REJECT every time; each
+        # reject is LOW_TOLERANCE (-10); the peer must be gone well before
+        # 10 messages
+        state = b.chain.head_state()
+        for i in range(8):
+            bad = Fields(
+                slot=9,
+                beacon_block_root=b.chain.head_root,
+                validator_index=i,
+                signature=bytes([i]) * 96,  # malformed signature
+            )
+            # vary the payload so the seen-cache doesn't absorb them
+            try:
+                await net_b.publish_sync_committee_message(bad, subnet=0)
+            except Exception:
+                break  # connection already dropped by A
+            await asyncio.sleep(0.05)
+        assert await wait_until(lambda: len(net_a.peer_manager.peers) == 0), (
+            "byzantine peer still connected"
+        )
+
+        await net_b.close()
+        await net_a.close()
+        pool_a.close()
+        pool_b.close()
+
+    asyncio.run(main())
+
+
+def test_score_store_decay_and_states():
+    store = PeerRpcScoreStore()
+    key = "10.0.0.1"
+    assert store.state(key) == ScoreState.HEALTHY
+    store.apply_action(key, PeerAction.MID_TOLERANCE)
+    assert store.state(key) == ScoreState.HEALTHY
+    for _ in range(3):
+        store.apply_action(key, PeerAction.LOW_TOLERANCE)
+    assert store.state(key) == ScoreState.DISCONNECT
+    for _ in range(5):
+        store.apply_action(key, PeerAction.LOW_TOLERANCE)
+    assert store.state(key) == ScoreState.BANNED
+    assert store.score(key) >= -100.0
+    store.apply_action("other", PeerAction.FATAL)
+    assert store.state("other") == ScoreState.BANNED
+    # decay pulls scores back toward zero over time
+    store._last_update[key] -= 36000  # simulate 10 hours
+    assert store.state(key) == ScoreState.HEALTHY
