@@ -153,6 +153,78 @@ def bench_small_bucket(n: int = 16, budget_s: float = 120.0):
     return min(times)
 
 
+def bench_scale_250k(budget_s: float = 180.0):
+    """Mainnet-preset 250k-validator measurements (BASELINE.md configs
+    #3/#5 groundwork; reference perf state: state-transition/test/perf/
+    util.ts:49): steady-state epoch transition (warm HTR cache + reused
+    EpochContext — a following node's condition) and a 128-attestation
+    block apply.  Returns dict or None over budget."""
+    import time as _t
+
+    from lodestar_tpu.config.chain_config import ChainConfig
+    from lodestar_tpu.params import MAINNET
+    from lodestar_tpu.spec_test_util.perf_state import build_perf_state
+    from lodestar_tpu.ssz import Fields
+    from lodestar_tpu.state_transition import process_slots
+    from lodestar_tpu.state_transition.misc import compute_start_slot_at_epoch
+    from lodestar_tpu.state_transition.upgrade import state_types
+
+    t_start = _t.perf_counter()
+    cfg = ChainConfig(
+        PRESET_BASE="mainnet", MIN_GENESIS_TIME=0,
+        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16384,
+    )
+    state, ctx = build_perf_state(MAINNET, cfg, 250_000)
+    state_types(MAINNET, state).BeaconState.hash_tree_root(state)  # warm subtrees
+    if _t.perf_counter() - t_start > budget_s:
+        return None
+
+    # block apply at a non-boundary slot with a full load of attestations
+    from lodestar_tpu.state_transition.block import process_attestation
+
+    epoch = state.slot // MAINNET.SLOTS_PER_EPOCH
+    att_slot = state.slot - MAINNET.MIN_ATTESTATION_INCLUSION_DELAY
+    boundary = bytes(
+        state.block_roots[
+            compute_start_slot_at_epoch(MAINNET, epoch) % MAINNET.SLOTS_PER_HISTORICAL_ROOT
+        ]
+    )
+    atts = []
+    for index in range(min(MAINNET.MAX_ATTESTATIONS, ctx.get_committee_count_per_slot(epoch))):
+        committee = ctx.get_beacon_committee(att_slot, index)
+        atts.append(
+            Fields(
+                aggregation_bits=[True] * len(committee),
+                data=Fields(
+                    slot=att_slot, index=index,
+                    beacon_block_root=bytes(
+                        state.block_roots[att_slot % MAINNET.SLOTS_PER_HISTORICAL_ROOT]
+                    ),
+                    source=Fields(
+                        epoch=state.current_justified_checkpoint.epoch,
+                        root=bytes(state.current_justified_checkpoint.root),
+                    ),
+                    target=Fields(epoch=epoch, root=boundary),
+                ),
+                signature=b"\x00" * 96,
+            )
+        )
+    t0 = _t.perf_counter()
+    for att in atts:
+        process_attestation(MAINNET, ctx, state, att, False)
+    block_atts_ms = (_t.perf_counter() - t0) * 1e3
+
+    # steady-state epoch transition: reused ctx, warm HTR cache
+    t0 = _t.perf_counter()
+    process_slots(MAINNET, cfg, state, state.slot + 1, ctx)
+    epoch_ms = (_t.perf_counter() - t0) * 1e3
+    return {
+        "epoch_transition_ms_250k": round(epoch_ms),
+        "block_attestations_ms_250k": round(block_atts_ms),
+        "n_attestations": len(atts),
+    }
+
+
 def bench_dev_chain(time_budget_s: float = 150.0):
     """blocks/s through DevChain.run with the DEVICE verifier — the e2e
     figure (STF + fork choice + batched kernel per block).  Soft-skipped
@@ -204,6 +276,10 @@ def main() -> None:
     cpu_oracle = bench_cpu_oracle()
     small_dt = bench_small_bucket()
     chain_rate = bench_dev_chain()
+    try:
+        scale = bench_scale_250k()
+    except Exception:
+        scale = None
     import jax
 
     baseline = cpu_native if cpu_native else cpu_oracle
@@ -222,6 +298,7 @@ def main() -> None:
                     "cpu_oracle_sets_per_s": round(cpu_oracle, 3),
                     "baseline_kind": "fastbls-c" if cpu_native else "python-oracle",
                     "dev_chain_blocks_per_s": round(chain_rate, 3) if chain_rate else None,
+                    "scale_250k": scale,
                     "backend": jax.default_backend(),
                 },
             }

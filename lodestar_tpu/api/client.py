@@ -61,6 +61,46 @@ class ApiClient:
     async def post(self, path: str, body: Any) -> Any:
         return await self._request("POST", path, body)
 
+    async def events(self, topics: str = "head,block,finalized_checkpoint"):
+        """Async generator over the /eth/v1/events SSE stream: yields
+        (event_name, data_dict).  The connection stays open until the
+        caller stops iterating (routes/events.ts client side)."""
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        try:
+            req = (
+                f"GET /eth/v1/events?topics={topics} HTTP/1.1\r\n"
+                f"host: {self.host}\r\n\r\n"
+            ).encode()
+            writer.write(req)
+            await writer.drain()
+            status_line = await reader.readline()
+            try:
+                status = int(status_line.split()[1])
+            except (IndexError, ValueError):
+                raise ApiClientError(0, f"bad SSE status line: {status_line!r}")
+            if status != 200:
+                raise ApiClientError(status, "events stream rejected")
+            while True:
+                h = await reader.readline()
+                if h in (b"\r\n", b"\n", b""):
+                    break
+            event_name = None
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                line = line.strip()
+                if line.startswith(b"event:"):
+                    event_name = line[6:].strip().decode()
+                elif line.startswith(b"data:") and event_name:
+                    yield event_name, json.loads(line[5:].strip())
+                    event_name = None
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
 
 class ApiClientError(Exception):
     def __init__(self, status: int, body: Any):

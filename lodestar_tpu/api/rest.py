@@ -116,6 +116,28 @@ class RestApiServer:
                 status, payload, ctype = await self._dispatch(method, target, body)
                 if self.metrics:
                     self.metrics.api_requests_total.labels(status=str(status)).inc()
+                if ctype == "text/event-stream":
+                    # SSE (routes/events.ts): stream chain events until the
+                    # client goes away; the payload is an async generator
+                    writer.write(
+                        b"HTTP/1.1 200 OK\r\n"
+                        b"content-type: text/event-stream\r\n"
+                        b"cache-control: no-cache\r\n"
+                        b"connection: close\r\n\r\n"
+                    )
+                    await writer.drain()
+                    try:
+                        async for chunk in payload:
+                            writer.write(chunk)
+                            await writer.drain()
+                    except (ConnectionError, asyncio.CancelledError):
+                        pass
+                    finally:
+                        # run the generator's finally NOW (emitter
+                        # unsubscribe) instead of at GC time — stale
+                        # subscriptions would outlive the client
+                        await payload.aclose()
+                    break
                 data = payload if isinstance(payload, bytes) else json.dumps(payload).encode()
                 writer.write(
                     b"HTTP/1.1 %d %s\r\n" % (status, b"OK" if status < 400 else b"Error")
@@ -193,6 +215,8 @@ class RestApiServer:
         # checkpoint-sync server side (initBeaconState.ts fetches this)
         r("GET", "/eth/v2/debug/beacon/states/{state_id}", self._debug_state)
         r("GET", "/eth/v2/beacon/blocks/{block_id}", self._block_ssz)
+        # events SSE (routes/events.ts:20): head/block/finalized stream
+        r("GET", "/eth/v1/events", self._events)
         r("GET", "/metrics", self._metrics)
 
     def _state_for(self, state_id: str):
@@ -215,6 +239,73 @@ class RestApiServer:
                 raise ApiError(404, "state not found")
             return st
         raise ApiError(400, f"unsupported state id {state_id}")
+
+    def _events(self, pp, q, b):
+        """SSE stream of chain events (routes/events.ts:20).  ?topics=
+        comma-list filters among head, block, finalized_checkpoint."""
+        from ..chain.emitter import ChainEvent
+
+        wanted = set((q.get("topics") or "head,block,finalized_checkpoint").split(","))
+        queue: asyncio.Queue = asyncio.Queue(maxsize=256)
+        chain = self.chain
+
+        def _put(name: str, data: dict) -> None:
+            try:
+                queue.put_nowait((name, data))
+            except asyncio.QueueFull:
+                pass  # slow consumer: drop rather than grow unboundedly
+
+        def on_head(root: bytes) -> None:
+            node = chain.fork_choice.get_block(root)
+            _put(
+                "head",
+                {
+                    "slot": str(node.slot if node else 0),
+                    "block": "0x" + root.hex(),
+                    "state": "0x" + (node.state_root.hex() if node else "00" * 32),
+                    "epoch_transition": False,
+                },
+            )
+
+        def on_block(signed_block, root: bytes) -> None:
+            _put(
+                "block",
+                {"slot": str(signed_block.message.slot), "block": "0x" + root.hex()},
+            )
+
+        def on_finalized(cp) -> None:
+            _put(
+                "finalized_checkpoint",
+                {"epoch": str(cp.epoch), "block": "0x" + cp.root.hex()},
+            )
+
+        subs = []
+        if "head" in wanted:
+            chain.emitter.on(ChainEvent.HEAD, on_head)
+            subs.append((ChainEvent.HEAD, on_head))
+        if "block" in wanted:
+            chain.emitter.on(ChainEvent.BLOCK, on_block)
+            subs.append((ChainEvent.BLOCK, on_block))
+        if "finalized_checkpoint" in wanted:
+            chain.emitter.on(ChainEvent.FINALIZED, on_finalized)
+            subs.append((ChainEvent.FINALIZED, on_finalized))
+
+        async def stream():
+            try:
+                while True:
+                    try:
+                        name, data = await asyncio.wait_for(queue.get(), 15.0)
+                    except asyncio.TimeoutError:
+                        yield b": keep-alive\n\n"
+                        continue
+                    yield (
+                        f"event: {name}\ndata: {json.dumps(data)}\n\n".encode()
+                    )
+            finally:
+                for ev, fn in subs:
+                    chain.emitter.off(ev, fn)
+
+        return stream(), "text/event-stream"
 
     def _debug_state(self, pp, q, b):
         """Fork-tagged SSZ state (1 tag byte + SSZ — the same codec the db

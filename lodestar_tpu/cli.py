@@ -274,6 +274,11 @@ async def run_validator(args) -> int:
     gvr = bytes.fromhex(genesis["data"]["genesis_validators_root"][2:])
     store = ValidatorStore(preset, cfg, keys, protection, genesis_validators_root=gvr)
     vc = ValidatorClient(preset, cfg, store, api)
+    from .validator import ChainHeaderTracker
+
+    tracker = ChainHeaderTracker(api)
+    tracker.start()
+    vc.header_tracker = tracker
     logger.info("validator client: %d keys against %s", len(keys), args.beacon_url)
     slot = 1
     try:
@@ -281,11 +286,13 @@ async def run_validator(args) -> int:
             syncing = await api.get("/eth/v1/node/syncing")
             head = int(syncing["data"]["head_slot"])
             slot = max(slot, head + 1)
-            await vc.run_slot(slot)
+            # wait up to 1/3 slot for the head event before attesting
+            await vc.run_slot(slot, head_wait_s=cfg.SECONDS_PER_SLOT / 3)
             slot += 1
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     finally:
+        await tracker.stop()
         protection.close()  # fold the WAL into the interchange file
     return 0
 

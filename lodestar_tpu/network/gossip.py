@@ -29,8 +29,10 @@ TOPIC_ATTESTER_SLASHING = "attester_slashing"
 TOPIC_SYNC_CONTRIBUTION = "sync_committee_contribution_and_proof"
 TOPIC_SYNC_COMMITTEE = "sync_committee_{subnet}"
 
-ATTESTATION_SUBNET_COUNT = 64
-SYNC_COMMITTEE_SUBNET_COUNT = 4
+from ..params.presets import (  # noqa: E402 - single source of truth
+    ATTESTATION_SUBNET_COUNT,
+    SYNC_COMMITTEE_SUBNET_COUNT,
+)
 
 
 def topic_string(fork_digest: bytes, name: str) -> str:

@@ -86,11 +86,19 @@ async def fetch_checkpoint_state(
     else:
         # wall-clock epoch from the fetched state's own genesis time — the
         # default MUST be the real clock, not the checkpoint's epoch, or
-        # the staleness check below can never fire (review r4)
+        # the staleness check below can never fire (review r4).  Dev/interop
+        # chains carry a synthetic genesis_time (seconds since 1970 ≈ 0)
+        # whose wall-clock epoch is astronomically large and meaningless:
+        # there the TRUSTED remote's own head is the only clock available.
         import time as _time
 
-        seconds = max(0, int(_time.time()) - int(state.genesis_time))
-        now_epoch = seconds // cfg.SECONDS_PER_SLOT // preset.SLOTS_PER_EPOCH
+        if int(state.genesis_time) < 1_000_000_000:  # pre-2001: synthetic
+            syncing = await api.get("/eth/v1/node/syncing")
+            head_slot = int(syncing["data"]["head_slot"])
+            now_epoch = head_slot // preset.SLOTS_PER_EPOCH
+        else:
+            seconds = max(0, int(_time.time()) - int(state.genesis_time))
+            now_epoch = seconds // cfg.SECONDS_PER_SLOT // preset.SLOTS_PER_EPOCH
     if not is_within_weak_subjectivity_period(preset, state, ws_epoch, now_epoch):
         raise CheckpointSyncError(
             f"checkpoint at epoch {ws_epoch} is outside the weak-subjectivity "

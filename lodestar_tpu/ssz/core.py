@@ -120,6 +120,20 @@ def mix_in_selector(root: bytes, selector: int) -> bytes:
     return hash_pair(root, selector.to_bytes(32, "little"))
 
 
+def _pack_basic_list(elem: "SszType", value) -> bytes:
+    """Serialize a homogeneous basic-type list to its packed byte body.
+    uint64 lists (balances: 250k+ entries every state root) go through a
+    single numpy tobytes instead of 250k int.to_bytes calls."""
+    if value and isinstance(elem, Uint) and elem.byte_len == 8:
+        import numpy as _np
+
+        try:
+            return _np.asarray(value, dtype=_np.uint64).tobytes()
+        except (OverflowError, TypeError, ValueError):
+            pass  # odd inputs (e.g. mixed types) take the slow path
+    return b"".join(elem.serialize(v) for v in value)
+
+
 def pack_bytes(data: bytes) -> PyList[bytes]:
     """Right-pad to a chunk multiple and split into 32-byte chunks."""
     if not data:
@@ -289,7 +303,7 @@ class Vector(SszType):
         if len(value) != self.length:
             raise ValueError("Vector length mismatch")
         if isinstance(self.elem, (Uint, Boolean)):
-            return merkleize(pack_bytes(b"".join(self.elem.serialize(v) for v in value)))
+            return merkleize(pack_bytes(_pack_basic_list(self.elem, value)))
         return merkleize([self.elem.hash_tree_root(v) for v in value])
 
     def default(self):
@@ -319,7 +333,7 @@ class List(SszType):
         if len(value) > self.limit:
             raise ValueError("List over limit")
         if isinstance(self.elem, (Uint, Boolean)):
-            body = b"".join(self.elem.serialize(v) for v in value)
+            body = _pack_basic_list(self.elem, value)
             limit_chunks = (self.limit * self.elem.fixed_size() + 31) // 32
             root = merkleize(pack_bytes(body), limit_chunks)
         else:
@@ -411,12 +425,20 @@ class Bitlist(SszType):
 
 
 class Fields:
-    """Container value: attribute access over an ordered field dict."""
+    """Container value: attribute access over an ordered field dict.
 
-    __slots__ = ("_d",)
+    ``_htr`` memoizes the hash-tree-root for SCALAR-ONLY containers
+    (Container.hash_tree_root decides eligibility): any attribute/item
+    write invalidates it.  This is the flat-value answer to the
+    reference's persistent-merkle-tree structural sharing — a 250k-entry
+    validator registry re-roots in the hashes of its few dirty entries
+    instead of all of them."""
+
+    __slots__ = ("_d", "_htr")
 
     def __init__(self, **kwargs):
         object.__setattr__(self, "_d", dict(kwargs))
+        object.__setattr__(self, "_htr", None)
 
     def __getattr__(self, k):
         # robust under copy/pickle: _d may not exist yet, and dunder probes
@@ -435,21 +457,25 @@ class Fields:
 
     def __setstate__(self, state):
         object.__setattr__(self, "_d", state)
+        object.__setattr__(self, "_htr", None)
 
     def __setattr__(self, k, v):
         self._d[k] = v
+        object.__setattr__(self, "_htr", None)
 
     def __delattr__(self, k):
         try:
             del self._d[k]
         except KeyError:
             raise AttributeError(k) from None
+        object.__setattr__(self, "_htr", None)
 
     def __getitem__(self, k):
         return self._d[k]
 
     def __setitem__(self, k, v):
         self._d[k] = v
+        object.__setattr__(self, "_htr", None)
 
     def __contains__(self, k):
         return k in self._d
@@ -527,8 +553,22 @@ class Container(SszType):
         return Fields(**values)
 
     def hash_tree_root(self, value) -> bytes:
+        # memoized fast path: a Fields whose values are ALL scalars
+        # (int/bytes/bool) cannot be mutated behind our back — nested
+        # lists/Fields could, so only the leaf-container shape is cached
+        cacheable = isinstance(value, Fields)
+        if cacheable:
+            cached = object.__getattribute__(value, "_htr")
+            if cached is not None and cached[0] is self:
+                return cached[1]
         roots = [ftype.hash_tree_root(value[fname]) for fname, ftype in self.fields]
-        return merkleize(roots)
+        root = merkleize(roots)
+        if cacheable and all(
+            isinstance(v, (int, bytes, bool))
+            for v in object.__getattribute__(value, "_d").values()
+        ):
+            object.__setattr__(value, "_htr", (self, root))
+        return root
 
     def get_field_proof(self, value, field_name: str):
         """Merkle branch proving `field_name`'s subtree root against this

@@ -396,15 +396,17 @@ def process_inactivity_updates(p: Preset, cfg: ChainConfig, state) -> None:
     eligible = _eligible_mask(p, state)
     finality_delay = previous_epoch - state.finalized_checkpoint.epoch
     is_leak = finality_delay > p.MIN_EPOCHS_TO_INACTIVITY_PENALTY
-    for i in np.nonzero(eligible)[0]:
-        score = state.inactivity_scores[i]
-        if target_mask[i]:
-            score -= min(1, score)
-        else:
-            score += cfg.INACTIVITY_SCORE_BIAS
-        if not is_leak:
-            score -= min(cfg.INACTIVITY_SCORE_RECOVERY_RATE, score)
-        state.inactivity_scores[i] = score
+    scores = np.asarray(state.inactivity_scores, dtype=np.int64)
+    updated = np.where(
+        target_mask,
+        scores - np.minimum(1, scores),
+        scores + cfg.INACTIVITY_SCORE_BIAS,
+    )
+    if not is_leak:
+        updated = updated - np.minimum(cfg.INACTIVITY_SCORE_RECOVERY_RATE, updated)
+    state.inactivity_scores = (
+        np.where(eligible, updated, scores).astype(np.uint64).tolist()
+    )
 
 
 def get_flag_index_deltas(p: Preset, state, flag_index: int):
@@ -451,7 +453,12 @@ def get_inactivity_penalty_deltas(p: Preset, cfg: ChainConfig, state):
         p, state, TIMELY_TARGET_FLAG_INDEX, previous_epoch
     )
     eligible = _eligible_mask(p, state)
-    for i in np.nonzero(eligible & ~target_mask)[0]:
+    hit = eligible & ~target_mask
+    # python-int products: eb * inactivity_score can exceed int64 during
+    # long leaks; keep the per-hit loop but bound it to the hit set (tiny
+    # outside leaks) instead of iterating the whole registry
+    for i in np.nonzero(hit)[0]:
+        i = int(i)
         penalty_numerator = state.validators[i].effective_balance * state.inactivity_scores[i]
         penalties[i] += penalty_numerator // (
             cfg.INACTIVITY_SCORE_BIAS * p.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
@@ -471,9 +478,11 @@ def process_rewards_and_penalties_altair(p: Preset, cfg: ChainConfig, state) -> 
         rewards += r
         penalties += pn
     penalties += get_inactivity_penalty_deltas(p, cfg, state)
-    for i in range(n):
-        bal = state.balances[i] + int(rewards[i]) - int(penalties[i])
-        state.balances[i] = max(0, bal)
+    # vectorized write-back (mirrors the phase0 path; mainnet IS altair+,
+    # so this loop is the one production actually runs at 250k+ registry
+    # sizes — review r4)
+    bal = np.asarray(state.balances, dtype=np.int64)
+    state.balances = np.maximum(0, bal + rewards - penalties).astype(np.uint64).tolist()
 
 
 def process_slashings_altair(p: Preset, state) -> None:
@@ -483,11 +492,19 @@ def process_slashings_altair(p: Preset, state) -> None:
     total_slashings = sum(state.slashings)
     adjusted = min(total_slashings * p.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR, total)
     increment = p.EFFECTIVE_BALANCE_INCREMENT
-    for i, v in enumerate(state.validators):
-        if v.slashed and epoch + p.EPOCHS_PER_SLASHINGS_VECTOR // 2 == v.withdrawable_epoch:
-            penalty_numerator = (v.effective_balance // increment) * adjusted
-            penalty = penalty_numerator // total * increment
-            state.balances[i] = max(0, state.balances[i] - penalty)
+    n = len(state.validators)
+    slashed = np.fromiter((v.slashed for v in state.validators), bool, count=n)
+    withdrawable = np.fromiter(
+        (v.withdrawable_epoch for v in state.validators), np.uint64, count=n
+    )
+    for i in np.nonzero(
+        slashed & (withdrawable == epoch + p.EPOCHS_PER_SLASHINGS_VECTOR // 2)
+    )[0]:
+        i = int(i)
+        v = state.validators[i]
+        penalty_numerator = (v.effective_balance // increment) * adjusted
+        penalty = penalty_numerator // total * increment
+        state.balances[i] = max(0, state.balances[i] - penalty)
 
 
 def process_participation_flag_updates(state) -> None:

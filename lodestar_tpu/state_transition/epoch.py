@@ -260,9 +260,11 @@ def process_rewards_and_penalties(p: Preset, cfg: ChainConfig, state, flags: Epo
     if flags.current_epoch == GENESIS_EPOCH:
         return
     rewards, penalties = get_attestation_deltas(p, cfg, state, flags)
-    for i in range(len(state.balances)):
-        bal = state.balances[i] + int(rewards[i]) - int(penalties[i])
-        state.balances[i] = max(0, bal)
+    # one vectorized pass + a C-level tolist(): the 250k-iteration python
+    # write loop was the scale bottleneck (VERDICT r3 item 4)
+    bal = np.asarray(state.balances, dtype=np.int64)
+    new_bal = np.maximum(0, bal + rewards.astype(np.int64) - penalties.astype(np.int64))
+    state.balances = new_bal.astype(np.uint64).tolist()
 
 
 # -- registry ----------------------------------------------------------------
@@ -270,30 +272,37 @@ def process_rewards_and_penalties(p: Preset, cfg: ChainConfig, state, flags: Epo
 
 def process_registry_updates(p: Preset, cfg: ChainConfig, state) -> None:
     current_epoch = compute_epoch_at_slot(p, state.slot)
-    # eligibility
-    for i, v in enumerate(state.validators):
-        if (
-            v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
-            and v.effective_balance == p.MAX_EFFECTIVE_BALANCE
-        ):
-            v.activation_eligibility_epoch = current_epoch + 1
-        if (
-            (v.activation_epoch <= current_epoch < v.exit_epoch)
-            and v.effective_balance <= cfg.EJECTION_BALANCE
-        ):
-            initiate_validator_exit(p, cfg, state, i)
-    # activation queue, FIFO by (eligibility epoch, index)
-    queue = sorted(
-        (
-            i
-            for i, v in enumerate(state.validators)
-            if v.activation_eligibility_epoch != FAR_FUTURE_EPOCH
-            and v.activation_eligibility_epoch <= state.finalized_checkpoint.epoch
-            and v.activation_epoch == FAR_FUTURE_EPOCH
-        ),
-        key=lambda i: (state.validators[i].activation_eligibility_epoch, i),
+    n = len(state.validators)
+    # columnar prefilters: the conditions hit a handful of validators per
+    # epoch; only those indices take the python path
+    elig_e = np.fromiter(
+        (v.activation_eligibility_epoch for v in state.validators), np.uint64, count=n
     )
-    active_count = len(get_active_validator_indices(state, current_epoch))
+    act_e = np.fromiter((v.activation_epoch for v in state.validators), np.uint64, count=n)
+    exit_e = np.fromiter((v.exit_epoch for v in state.validators), np.uint64, count=n)
+    eb = np.fromiter((v.effective_balance for v in state.validators), np.uint64, count=n)
+
+    for i in np.nonzero(
+        (elig_e == FAR_FUTURE_EPOCH) & (eb == p.MAX_EFFECTIVE_BALANCE)
+    )[0]:
+        state.validators[int(i)].activation_eligibility_epoch = current_epoch + 1
+    for i in np.nonzero(
+        (act_e <= current_epoch) & (current_epoch < exit_e) & (eb <= cfg.EJECTION_BALANCE)
+    )[0]:
+        initiate_validator_exit(p, cfg, state, int(i))
+
+    # activation queue, FIFO by (eligibility epoch, index); re-read
+    # eligibility since the first pass may have set it this epoch
+    elig_e = np.fromiter(
+        (v.activation_eligibility_epoch for v in state.validators), np.uint64, count=n
+    )
+    candidates = np.nonzero(
+        (elig_e != FAR_FUTURE_EPOCH)
+        & (elig_e <= state.finalized_checkpoint.epoch)
+        & (act_e == FAR_FUTURE_EPOCH)
+    )[0]
+    queue = sorted((int(i) for i in candidates), key=lambda i: (int(elig_e[i]), i))
+    active_count = int(((act_e <= current_epoch) & (current_epoch < exit_e)).sum())
     churn = get_validator_churn_limit(cfg, active_count)
     for i in queue[:churn]:
         state.validators[i].activation_epoch = compute_activation_exit_epoch(p, current_epoch)
@@ -309,11 +318,20 @@ def process_slashings(p: Preset, state, flags: EpochFlags) -> None:
     multiplier = p.PROPORTIONAL_SLASHING_MULTIPLIER
     adjusted = min(total_slashings * multiplier, total)
     increment = p.EFFECTIVE_BALANCE_INCREMENT
-    for i, v in enumerate(state.validators):
-        if v.slashed and epoch + p.EPOCHS_PER_SLASHINGS_VECTOR // 2 == v.withdrawable_epoch:
-            penalty_numerator = (v.effective_balance // increment) * adjusted
-            penalty = penalty_numerator // total * increment
-            state.balances[i] = max(0, state.balances[i] - penalty)
+    n = len(state.validators)
+    slashed = np.fromiter((v.slashed for v in state.validators), bool, count=n)
+    withdrawable = np.fromiter(
+        (v.withdrawable_epoch for v in state.validators), np.uint64, count=n
+    )
+    hits = np.nonzero(
+        slashed & (withdrawable == epoch + p.EPOCHS_PER_SLASHINGS_VECTOR // 2)
+    )[0]
+    for i in hits:
+        i = int(i)
+        v = state.validators[i]
+        penalty_numerator = (v.effective_balance // increment) * adjusted
+        penalty = penalty_numerator // total * increment
+        state.balances[i] = max(0, state.balances[i] - penalty)
 
 
 # -- housekeeping ------------------------------------------------------------
@@ -329,12 +347,17 @@ def process_effective_balance_updates(p: Preset, state) -> None:
     hysteresis_increment = p.EFFECTIVE_BALANCE_INCREMENT // p.HYSTERESIS_QUOTIENT
     down = hysteresis_increment * p.HYSTERESIS_DOWNWARD_MULTIPLIER
     up = hysteresis_increment * p.HYSTERESIS_UPWARD_MULTIPLIER
-    for i, v in enumerate(state.validators):
+    n = len(state.validators)
+    bal = np.asarray(state.balances, dtype=np.uint64)
+    eb = np.fromiter((v.effective_balance for v in state.validators), np.uint64, count=n)
+    # hysteresis means only validators whose balance drifted get touched
+    hits = np.nonzero((bal + down < eb) | (eb + up < bal))[0]
+    for i in hits:
+        i = int(i)
         balance = state.balances[i]
-        if balance + down < v.effective_balance or v.effective_balance + up < balance:
-            v.effective_balance = min(
-                balance - balance % p.EFFECTIVE_BALANCE_INCREMENT, p.MAX_EFFECTIVE_BALANCE
-            )
+        state.validators[i].effective_balance = min(
+            balance - balance % p.EFFECTIVE_BALANCE_INCREMENT, p.MAX_EFFECTIVE_BALANCE
+        )
 
 
 def process_slashings_reset(p: Preset, state) -> None:

@@ -70,6 +70,35 @@ def compute_epoch_shuffling(p: Preset, state, epoch: int) -> EpochShuffling:
     )
 
 
+class Index2PubkeyCache:
+    """index -> deserialized PublicKey, lazily (pubkeyCache.ts
+    Index2PubkeyCache keeps jacobian-deserialized keys; here the
+    deserialization itself is deferred until a signature set needs the
+    key, then memoized).  Append raw 48-byte pubkeys; read PublicKey."""
+
+    def __init__(self):
+        self._raw: List[bytes] = []
+        self._cache: dict = {}
+
+    def append(self, pk) -> None:
+        # accepts raw bytes or an already-deserialized PublicKey
+        if isinstance(pk, (bytes, bytearray)):
+            self._raw.append(bytes(pk))
+        else:
+            self._cache[len(self._raw)] = pk
+            self._raw.append(pk.to_bytes())
+
+    def __len__(self) -> int:
+        return len(self._raw)
+
+    def __getitem__(self, i: int) -> PublicKey:
+        pk = self._cache.get(i)
+        if pk is None:
+            pk = PublicKey.from_bytes(self._raw[i], validate=True)
+            self._cache[i] = pk
+        return pk
+
+
 class PubkeyIndexMap:
     """Globally shared pubkey registry (pubkeyCache.ts:29): serialized
     pubkey bytes -> validator index."""
@@ -124,20 +153,39 @@ class EpochContext:
         state,
         pubkey2index: Optional[PubkeyIndexMap] = None,
         index2pubkey: Optional[List[PublicKey]] = None,
+        prev_ctx: Optional["EpochContext"] = None,
     ) -> "EpochContext":
+        """``prev_ctx``: the context of the immediately-preceding epoch.
+        When given, the previous/current shufflings ROTATE out of it
+        (epochContext.ts afterProcessEpoch) and only the next-epoch
+        shuffling is computed fresh — sound because activations/exits
+        scheduled at an epoch boundary take effect >= 1 + MAX_SEED_LOOKAHEAD
+        epochs later and the seed mixes they read are already final.  At
+        mainnet registry sizes this cuts two of the three O(n·90-round)
+        shuffles per boundary."""
         p = preset
         if pubkey2index is None:
             pubkey2index = PubkeyIndexMap()
         if index2pubkey is None:
-            index2pubkey = []
+            index2pubkey = Index2PubkeyCache()
         cls._sync_pubkeys(state, pubkey2index, index2pubkey)
 
         current_epoch = compute_epoch_at_slot(p, state.slot)
         prev_epoch = max(0, current_epoch - 1)
-        cur_shuf = compute_epoch_shuffling(p, state, current_epoch)
-        prev_shuf = (
-            cur_shuf if prev_epoch == current_epoch else compute_epoch_shuffling(p, state, prev_epoch)
-        )
+        if (
+            prev_ctx is not None
+            and prev_ctx.current_shuffling.epoch == prev_epoch
+            and prev_ctx.next_shuffling.epoch == current_epoch
+        ):
+            prev_shuf = prev_ctx.current_shuffling
+            cur_shuf = prev_ctx.next_shuffling
+        else:
+            cur_shuf = compute_epoch_shuffling(p, state, current_epoch)
+            prev_shuf = (
+                cur_shuf
+                if prev_epoch == current_epoch
+                else compute_epoch_shuffling(p, state, prev_epoch)
+            )
         next_shuf = compute_epoch_shuffling(p, state, current_epoch + 1)
 
         proposers = cls._compute_proposers(p, state, current_epoch, cur_shuf.active_indices)
@@ -149,12 +197,16 @@ class EpochContext:
         return cls(p, pubkey2index, index2pubkey, prev_shuf, cur_shuf, next_shuf, proposers, ebi)
 
     @staticmethod
-    def _sync_pubkeys(state, pubkey2index: PubkeyIndexMap, index2pubkey: List[PublicKey]) -> None:
-        """Index new validators (epochContext.ts syncPubkeys)."""
+    def _sync_pubkeys(state, pubkey2index: PubkeyIndexMap, index2pubkey) -> None:
+        """Index new validators (epochContext.ts syncPubkeys).  Pubkey
+        deserialization is LAZY (Index2PubkeyCache): a mainnet-scale
+        registry (250k-500k keys) would otherwise pay one bigint sqrt +
+        subgroup check per key up front — minutes to hours of startup —
+        while the node only ever touches the keys that actually sign."""
         for i in range(len(index2pubkey), len(state.validators)):
             pk_bytes = bytes(state.validators[i].pubkey)
             pubkey2index.set(pk_bytes, i)
-            index2pubkey.append(PublicKey.from_bytes(pk_bytes, validate=True))
+            index2pubkey.append(pk_bytes)
 
     @staticmethod
     def _compute_proposers(p: Preset, state, epoch: int, active_indices: Sequence[int]) -> List[int]:

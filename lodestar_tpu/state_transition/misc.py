@@ -49,7 +49,19 @@ def is_active_validator(v, epoch: int) -> bool:
 
 
 def get_active_validator_indices(state, epoch: int) -> List[int]:
-    return [i for i, v in enumerate(state.validators) if is_active_validator(v, epoch)]
+    # columnar: two array pulls + one boolean mask beat 250k+ attribute
+    # probes at registry scale
+    import numpy as np
+
+    activation = np.fromiter(
+        (v.activation_epoch for v in state.validators), dtype=np.uint64,
+        count=len(state.validators),
+    )
+    exit_e = np.fromiter(
+        (v.exit_epoch for v in state.validators), dtype=np.uint64,
+        count=len(state.validators),
+    )
+    return np.nonzero((activation <= epoch) & (epoch < exit_e))[0].tolist()
 
 
 def get_randao_mix(p: Preset, state, epoch: int) -> bytes:

@@ -67,7 +67,7 @@ def process_slots(
                 process_epoch_altair(p, cfg, ctx, state)
             state.slot += 1
             ctx = EpochContext.create_from_state(
-                p, state, ctx.pubkey2index, ctx.index2pubkey
+                p, state, ctx.pubkey2index, ctx.index2pubkey, prev_ctx=ctx
             )
             # fork upgrades fire on the first slot of their epoch
             # (stateTransition.ts:100-144)

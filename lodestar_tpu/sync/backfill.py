@@ -87,6 +87,15 @@ class BackfillSync:
             )
         return sets
 
+    def _links(self, blocks: List) -> bool:
+        """Cheap pre-check: does this batch's newest block hash into the
+        trust frontier?  (Full verification happens in _verify_and_store;
+        this only decides range-vs-by-root fetching.)"""
+        if not blocks or self.oldest_root_parent is None:
+            return False
+        t = block_types(self.p, blocks[-1].message)
+        return t.BeaconBlock.hash_tree_root(blocks[-1].message) == self.oldest_root_parent
+
     def _verify_linkage(self, blocks: List) -> None:
         """blocks ascending by slot; the newest must parent-link into the
         current trust frontier, and every adjacent pair must chain
@@ -149,9 +158,17 @@ class BackfillSync:
             batches += 1
             try:
                 blocks = await peer.reqresp.blocks_by_range(start, count)
-                if not blocks:
-                    # a fully empty historical range is impossible below the
-                    # anchor unless the peer is withholding; try another
+                if not blocks or not self._links(blocks):
+                    # the parent may sit beyond the 64-slot window (long
+                    # empty stretch): fetch it by ROOT and link through it
+                    # before judging the peer (review r4 — a fixed window
+                    # can never cross a gap wider than itself)
+                    by_root = await peer.reqresp.blocks_by_root([self.oldest_root_parent])
+                    if by_root:
+                        stored += await self._verify_and_store(by_root[:1])
+                        continue
+                    # nothing by range AND the parent unknown by root:
+                    # withholding or pruned — try another peer
                     peer.penalize(5)
                     continue
                 stored += await self._verify_and_store(blocks)

@@ -6,5 +6,6 @@ with the EIP-3076 interchange format).
 """
 
 from .client import ValidatorClient  # noqa: F401
+from .header_tracker import ChainHeaderTracker  # noqa: F401
 from .slashing_protection import SlashingProtection, SlashingError  # noqa: F401
 from .store import ValidatorStore  # noqa: F401

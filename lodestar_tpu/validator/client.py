@@ -38,6 +38,10 @@ class ValidatorClient:
         # instance is live with our keys -> refuse to start
         self.doppelganger_epochs = doppelganger_epochs
         self._doppelganger_window: Optional[set] = None
+        # optional ChainHeaderTracker (services/chainHeaderTracker.ts):
+        # when present, attestations trigger on the head SSE event
+        self.header_tracker = None
+        self.attested_on_event = 0
 
     class DoppelgangerDetected(Exception):
         pass
@@ -241,13 +245,20 @@ class ValidatorClient:
                 )
         return submitted
 
-    async def run_slot(self, slot: int) -> None:
+    async def run_slot(self, slot: int, head_wait_s: float = 0.0) -> None:
         if self.doppelganger_epochs:
             # no duty signs anything until the observation window clears
             if not await self.check_doppelganger(compute_epoch_at_slot(self.p, slot)):
                 logger.info("doppelganger window open — skipping duties for slot %d", slot)
                 return
         await self.propose_if_due(slot)
+        if self.header_tracker is not None and head_wait_s > 0:
+            # attest the moment the slot's block lands (head SSE event)
+            # rather than blind at the clock mark; the timeout is the
+            # 1/3-slot fallback (chainHeaderTracker.ts semantics)
+            on_event = await self.header_tracker.wait_for_slot_head(slot, head_wait_s)
+            if on_event:
+                self.attested_on_event += 1
         await self.attest(slot)
         await self.aggregate(slot)
         await self.sync_committee_duties(slot)

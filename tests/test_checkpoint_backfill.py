@@ -44,15 +44,18 @@ def test_checkpoint_sync_then_backfill_then_follow():
         rest_a = RestApiServer(MINIMAL, a.chain, network=net_a)
         rest_port = await rest_a.listen(0)
 
-        # the default current_epoch is the WALL clock — for an interop
-        # chain with genesis_time=1 that is astronomically far ahead, so
-        # the weak-subjectivity guard must refuse the stale checkpoint
+        # the weak-subjectivity guard refuses a checkpoint that is stale
+        # relative to the clock (here: an explicit far-future epoch; on
+        # interop chains the default clock falls back to the trusted
+        # remote's head, on real networks to the wall clock)
         import pytest as _pytest
 
         from lodestar_tpu.node.checkpoint_sync import CheckpointSyncError
 
         with _pytest.raises(CheckpointSyncError, match="weak-subjectivity"):
-            await fetch_checkpoint_state(MINIMAL, CFG, f"http://127.0.0.1:{rest_port}")
+            await fetch_checkpoint_state(
+                MINIMAL, CFG, f"http://127.0.0.1:{rest_port}", current_epoch=10**6
+            )
 
         # node B: checkpoint-sync boot from A's REST API, evaluated at the
         # chain's actual clock epoch

@@ -226,3 +226,35 @@ class TestRealDepositVector:
         pk = PublicKey.from_bytes(self.PUBKEY)
         sig = Signature.from_bytes(self.SIG)
         assert not verify(pk, bytes(root), sig)
+
+
+def test_container_htr_memoization_invalidates_on_mutation():
+    """The scalar-only Fields HTR cache must never serve a stale root:
+    attribute writes, item writes and deletes all invalidate it."""
+    from lodestar_tpu.ssz.core import Container, ByteVector, uint64, Fields
+
+    V = Container("V", [("a", uint64), ("pk", ByteVector(48))])
+    v = Fields(a=1, pk=b"\x11" * 48)
+    r1 = V.hash_tree_root(v)
+    assert V.hash_tree_root(v) == r1  # cached path agrees
+    v.a = 2
+    r2 = V.hash_tree_root(v)
+    assert r2 != r1
+    v["a"] = 1
+    assert V.hash_tree_root(v) == r1
+    # a container holding a MUTABLE child must not be cached: mutating
+    # the child through an alias changes the root
+    L = Container("L", [("xs", ByteVector(2)), ("n", uint64)])
+    import copy
+
+    w = Fields(xs=bytearray(b"ab"), n=1)
+    ra = L.hash_tree_root(w)
+    w.xs[0] = ord("z")  # in-place mutation, no Fields write
+    rb = L.hash_tree_root(w)
+    assert rb != ra  # would fail if the bytearray shape were cached
+
+    # deepcopy (clone_state) yields an independent cache
+    v2 = copy.deepcopy(v)
+    assert V.hash_tree_root(v2) == V.hash_tree_root(v)
+    v2.a = 99
+    assert V.hash_tree_root(v2) != V.hash_tree_root(v)
