@@ -1,0 +1,343 @@
+"""Generate a minimal-preset conformance-vector tree in the OFFICIAL
+ethereum/consensus-spec-tests directory format.
+
+Why self-generated vectors exist (VERDICT r3 item 8): this image has zero
+egress, so the official tarball cannot be fetched.  These vectors:
+  1. exercise every wired category of the spec-test harness end-to-end
+     (directory layout, ssz_snappy codec, yaml metas, coverage check),
+  2. pin today's behavior against regressions (any STF change that
+     shifts a state root fails the suite),
+  3. keep tests/test_spec_vectors.py byte-compatible with the official
+     tree — drop ethereum/consensus-spec-tests at spec-tests/ and the
+     same runners consume it unchanged.
+They are NOT independent conformance evidence; tests/test_spec_harness.py
+and the hand-pinned KATs carry that role until the official vectors can
+be vendored.
+
+Layout: spec-tests/tests/minimal/<fork>/<runner>/<handler>/<suite>/<case>/
+Run: python tools/gen_spec_vectors.py    (idempotent; output committed)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import yaml  # noqa: E402
+
+from lodestar_tpu.chain.bls_pool import BlsBatchPool  # noqa: E402
+from lodestar_tpu.config.chain_config import ChainConfig  # noqa: E402
+from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier  # noqa: E402
+from lodestar_tpu.node.dev_chain import DevChain, clone_state  # noqa: E402
+from lodestar_tpu.params import MINIMAL  # noqa: E402
+from lodestar_tpu.ssz import Fields  # noqa: E402
+from lodestar_tpu.state_transition import (  # noqa: E402
+    EpochContext,
+    process_slots,
+    state_transition,
+)
+from lodestar_tpu.types import get_types  # noqa: E402
+from lodestar_tpu.utils.snappy import frame_compress  # noqa: E402
+
+ROOT = os.path.join(os.path.dirname(__file__), "..", "spec-tests", "tests", "minimal")
+T = get_types(MINIMAL)
+
+CFG = ChainConfig(
+    PRESET_BASE="minimal", MIN_GENESIS_TIME=0, SHARD_COMMITTEE_PERIOD=0,
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
+    ALTAIR_FORK_EPOCH=2**64 - 1, BELLATRIX_FORK_EPOCH=2**64 - 1,
+)
+CFG_ALTAIR = ChainConfig(
+    PRESET_BASE="minimal", MIN_GENESIS_TIME=0, SHARD_COMMITTEE_PERIOD=0,
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
+    ALTAIR_FORK_EPOCH=1, BELLATRIX_FORK_EPOCH=2**64 - 1,
+)
+
+
+def case_dir(fork: str, runner: str, handler: str, suite: str, name: str) -> str:
+    d = os.path.join(ROOT, fork, runner, handler, suite, name)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def write_ssz(d: str, stem: str, data: bytes) -> None:
+    with open(os.path.join(d, f"{stem}.ssz_snappy"), "wb") as f:
+        f.write(frame_compress(data))
+
+
+def write_yaml(d: str, stem: str, obj) -> None:
+    with open(os.path.join(d, f"{stem}.yaml"), "w") as f:
+        yaml.safe_dump(obj, f)
+
+
+def state_bytes(fork: str, state) -> bytes:
+    return getattr(T, fork).BeaconState.serialize(state)
+
+
+def block_bytes(fork: str, signed) -> bytes:
+    return getattr(T, fork).SignedBeaconBlock.serialize(signed)
+
+
+async def build_chain(cfg, slots: int) -> DevChain:
+    pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.001)
+    dev = DevChain(MINIMAL, cfg, 16, pool)
+    await dev.run(slots)
+    return dev
+
+
+def canonical_blocks(dev: DevChain, lo: int, hi: int):
+    out = []
+    for slot in range(lo, hi + 1):
+        root = dev.chain.fork_choice.proto.get_ancestor(dev.chain.head_root, slot)
+        blk = dev.chain.get_block_by_root(root) if root else None
+        if blk is not None and blk.message.slot == slot:
+            out.append(blk)
+    return out
+
+
+def gen_sanity_and_finality(dev: DevChain) -> None:
+    # sanity/blocks: apply 2 blocks
+    pre = clone_state(MINIMAL, dev.chain.genesis_state)
+    blocks = canonical_blocks(dev, 1, 2)
+    post = clone_state(MINIMAL, pre)
+    for b in blocks:
+        post, _ = state_transition(
+            MINIMAL, CFG, post, b, verify_proposer_signature=False,
+            verify_signatures=False, verify_state_root=True,
+        )
+    d = case_dir("phase0", "sanity", "blocks", "pyspec_tests", "two_blocks")
+    write_ssz(d, "pre", state_bytes("phase0", pre))
+    for i, b in enumerate(blocks):
+        write_ssz(d, f"blocks_{i}", block_bytes("phase0", b))
+    write_ssz(d, "post", state_bytes("phase0", post))
+    write_yaml(d, "meta", {"blocks_count": len(blocks)})
+
+    # sanity/slots: cross an epoch boundary blockless
+    pre2 = clone_state(MINIMAL, post)
+    post2 = clone_state(MINIMAL, pre2)
+    n_slots = MINIMAL.SLOTS_PER_EPOCH
+    process_slots(MINIMAL, CFG, post2, post2.slot + n_slots)
+    d = case_dir("phase0", "sanity", "slots", "pyspec_tests", "over_epoch_boundary")
+    write_ssz(d, "pre", state_bytes("phase0", pre2))
+    write_ssz(d, "post", state_bytes("phase0", post2))
+    write_yaml(d, "slots", n_slots)
+
+    # finality/finality: full epochs until finalization advances
+    anchor_slot = 2 * MINIMAL.SLOTS_PER_EPOCH
+    pre3_root = dev.chain.fork_choice.proto.get_ancestor(dev.chain.head_root, anchor_slot)
+    pre3 = clone_state(MINIMAL, dev.chain.get_state_by_block_root(pre3_root))
+    blocks3 = canonical_blocks(dev, pre3.slot + 1, pre3.slot + 2 * MINIMAL.SLOTS_PER_EPOCH)
+    post3 = clone_state(MINIMAL, pre3)
+    for b in blocks3:
+        post3, _ = state_transition(
+            MINIMAL, CFG, post3, b, verify_proposer_signature=False,
+            verify_signatures=False, verify_state_root=True,
+        )
+    assert post3.finalized_checkpoint.epoch > pre3.finalized_checkpoint.epoch, (
+        "finality vector must actually finalize"
+    )
+    d = case_dir("phase0", "finality", "finality", "pyspec_tests", "two_epochs_finalize")
+    write_ssz(d, "pre", state_bytes("phase0", pre3))
+    for i, b in enumerate(blocks3):
+        write_ssz(d, f"blocks_{i}", block_bytes("phase0", b))
+    write_ssz(d, "post", state_bytes("phase0", post3))
+    write_yaml(d, "meta", {"blocks_count": len(blocks3)})
+
+
+def gen_epoch_processing(dev: DevChain) -> None:
+    from lodestar_tpu.state_transition.epoch import (
+        before_process_epoch,
+        process_effective_balance_updates,
+        process_justification_and_finalization,
+        process_registry_updates,
+        process_rewards_and_penalties,
+        process_slashings,
+    )
+
+    # a state at the last slot of an epoch, mid-chain (has attestations)
+    slot = 3 * MINIMAL.SLOTS_PER_EPOCH - 1
+    root = dev.chain.fork_choice.proto.get_ancestor(dev.chain.head_root, slot)
+    base = clone_state(MINIMAL, dev.chain.get_state_by_block_root(root))
+    if base.slot < slot:
+        process_slots(MINIMAL, CFG, base, slot)
+    ctx = EpochContext.create_from_state(MINIMAL, base)
+
+    def sub_case(handler: str, fn) -> None:
+        pre = clone_state(MINIMAL, base)
+        post = clone_state(MINIMAL, pre)
+        pctx = EpochContext.create_from_state(MINIMAL, post)
+        flags = before_process_epoch(MINIMAL, pctx, post)
+        fn(post, flags)
+        d = case_dir("phase0", "epoch_processing", handler, "pyspec_tests", "mid_chain")
+        write_ssz(d, "pre", state_bytes("phase0", pre))
+        write_ssz(d, "post", state_bytes("phase0", post))
+
+    sub_case(
+        "justification_and_finalization",
+        lambda st, fl: process_justification_and_finalization(MINIMAL, st, fl),
+    )
+    sub_case(
+        "rewards_and_penalties",
+        lambda st, fl: process_rewards_and_penalties(MINIMAL, CFG, st, fl),
+    )
+    sub_case("registry_updates", lambda st, fl: process_registry_updates(MINIMAL, CFG, st))
+    sub_case("slashings", lambda st, fl: process_slashings(MINIMAL, st, fl))
+    sub_case(
+        "effective_balance_updates",
+        lambda st, fl: process_effective_balance_updates(MINIMAL, st),
+    )
+
+
+def gen_operations(dev: DevChain) -> None:
+    from lodestar_tpu.state_transition.block import (
+        process_attestation,
+        process_block_header,
+    )
+
+    # operations/attestation: a block's first attestation applied alone
+    for slot in range(2, 4 * MINIMAL.SLOTS_PER_EPOCH):
+        root = dev.chain.fork_choice.proto.get_ancestor(dev.chain.head_root, slot)
+        blk = dev.chain.get_block_by_root(root) if root else None
+        if blk is not None and blk.message.slot == slot and len(blk.message.body.attestations):
+            parent_state = clone_state(
+                MINIMAL, dev.chain.get_state_by_block_root(bytes(blk.message.parent_root))
+            )
+            ctx = process_slots(MINIMAL, CFG, parent_state, slot)
+            att = blk.message.body.attestations[0]
+            pre = clone_state(MINIMAL, parent_state)
+            post = clone_state(MINIMAL, pre)
+            process_attestation(MINIMAL, ctx, post, att, False)
+            d = case_dir("phase0", "operations", "attestation", "pyspec_tests", "from_block")
+            write_ssz(d, "pre", state_bytes("phase0", pre))
+            write_ssz(d, "attestation", T.phase0.Attestation.serialize(att))
+            write_ssz(d, "post", state_bytes("phase0", post))
+            break
+
+    # operations/block_header
+    slot = 3
+    root = dev.chain.fork_choice.proto.get_ancestor(dev.chain.head_root, slot)
+    blk = dev.chain.get_block_by_root(root)
+    parent_state = clone_state(
+        MINIMAL, dev.chain.get_state_by_block_root(bytes(blk.message.parent_root))
+    )
+    ctx = process_slots(MINIMAL, CFG, parent_state, slot)
+    pre = clone_state(MINIMAL, parent_state)
+    post = clone_state(MINIMAL, pre)
+    process_block_header(MINIMAL, ctx, post, blk.message)
+    d = case_dir("phase0", "operations", "block_header", "pyspec_tests", "from_block")
+    write_ssz(d, "pre", state_bytes("phase0", pre))
+    write_ssz(d, "block", T.phase0.BeaconBlock.serialize(blk.message))
+    write_ssz(d, "post", state_bytes("phase0", post))
+
+
+def gen_transition(dev_altair: DevChain) -> None:
+    """fork/ (upgrade function) + transition/ (blocks across the fork)."""
+    # fork/fork: the pure upgrade on the epoch-1 boundary state
+    from lodestar_tpu.state_transition.upgrade import upgrade_state_to_altair
+
+    boundary_slot = MINIMAL.SLOTS_PER_EPOCH
+    root = dev_altair.chain.fork_choice.proto.get_ancestor(
+        dev_altair.chain.head_root, boundary_slot - 1
+    )
+    pre_state = clone_state(MINIMAL, dev_altair.chain.get_state_by_block_root(root))
+    # advance to the boundary WITHOUT the fork config applying the upgrade
+    process_slots(MINIMAL, CFG, pre_state, boundary_slot)
+    pre = clone_state(MINIMAL, pre_state)
+    ctx = EpochContext.create_from_state(MINIMAL, pre_state)
+    upgrade_state_to_altair(MINIMAL, CFG_ALTAIR, ctx, pre_state)  # in place
+    post = pre_state
+    d = case_dir("altair", "fork", "fork", "pyspec_tests", "epoch1_upgrade")
+    write_ssz(d, "pre", state_bytes("phase0", pre))
+    write_ssz(d, "post", state_bytes("altair", post))
+    write_yaml(d, "meta", {"fork": "altair"})
+
+    # transition/core: blocks crossing the fork boundary
+    genesis = clone_state(MINIMAL, dev_altair.chain.genesis_state)
+    blocks = canonical_blocks(dev_altair, 1, 2 * MINIMAL.SLOTS_PER_EPOCH)
+    post_t = clone_state(MINIMAL, genesis)
+    for b in blocks:
+        post_t, _ = state_transition(
+            MINIMAL, CFG_ALTAIR, post_t, b, verify_proposer_signature=False,
+            verify_signatures=False, verify_state_root=True,
+        )
+    d = case_dir("altair", "transition", "core", "pyspec_tests", "through_altair_fork")
+    write_ssz(d, "pre", state_bytes("phase0", genesis))
+    for i, b in enumerate(blocks):
+        fork = "phase0" if b.message.slot < MINIMAL.SLOTS_PER_EPOCH else "altair"
+        write_ssz(d, f"blocks_{i}", block_bytes(fork, b))
+    write_ssz(d, "post", state_bytes("altair", post_t))
+    write_yaml(
+        d, "meta",
+        {"post_fork": "altair", "fork_epoch": 1, "blocks_count": len(blocks)},
+    )
+
+
+def gen_ssz_static_and_shuffling(dev: DevChain) -> None:
+    state = dev.chain.head_state()
+    samples = {
+        "Checkpoint": (T.phase0.Checkpoint, state.finalized_checkpoint),
+        "Fork": (T.phase0.Fork, state.fork),
+        "Validator": (T.phase0.Validator, state.validators[0]),
+        "BeaconBlockHeader": (T.phase0.BeaconBlockHeader, state.latest_block_header),
+        "AttestationData": (
+            T.phase0.AttestationData,
+            state.previous_epoch_attestations[0].data
+            if len(state.previous_epoch_attestations)
+            else None,
+        ),
+        "Eth1Data": (T.phase0.Eth1Data, state.eth1_data),
+        "BeaconState": (T.phase0.BeaconState, state),
+    }
+    for name, (typ, value) in samples.items():
+        if value is None:
+            continue
+        d = case_dir("phase0", "ssz_static", name, "ssz_random", "case_0")
+        ser = typ.serialize(value)
+        write_ssz(d, "serialized", ser)
+        write_yaml(d, "roots", {"root": "0x" + typ.hash_tree_root(value).hex()})
+
+    # shuffling: the official mapping format; cross-checks the scalar
+    # compute_shuffled_index against the vectorized unshuffle (two
+    # independent in-repo implementations)
+    import numpy as np
+
+    from lodestar_tpu.state_transition.shuffle import unshuffle_list
+
+    seed = bytes(range(32))
+    for count in (2, 17, 64):
+        shuffled = unshuffle_list(
+            np.arange(count, dtype=np.int64), seed, MINIMAL.SHUFFLE_ROUND_COUNT
+        )
+        # official semantics: mapping[i] = shuffled position of index i
+        d = case_dir("phase0", "shuffling", "core", "shuffle", f"shuffle_0x{seed[:4].hex()}_{count}")
+        write_yaml(
+            d, "mapping",
+            {
+                "seed": "0x" + seed.hex(),
+                "count": count,
+                "mapping": [int(x) for x in shuffled],
+            },
+        )
+
+
+async def main() -> None:
+    if os.path.isdir(ROOT):
+        shutil.rmtree(ROOT)
+    dev = await build_chain(CFG, 4 * MINIMAL.SLOTS_PER_EPOCH + 2)
+    assert dev.chain.fork_choice.store.finalized_checkpoint.epoch >= 1
+    gen_sanity_and_finality(dev)
+    gen_epoch_processing(dev)
+    gen_operations(dev)
+    gen_ssz_static_and_shuffling(dev)
+    dev_altair = await build_chain(CFG_ALTAIR, 2 * MINIMAL.SLOTS_PER_EPOCH + 1)
+    gen_transition(dev_altair)
+    n = sum(len(files) for _, _, files in os.walk(ROOT))
+    print(f"wrote {n} files under {os.path.abspath(ROOT)}")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
