@@ -217,6 +217,9 @@ class RestApiServer:
         r("GET", "/eth/v2/beacon/blocks/{block_id}", self._block_ssz)
         # events SSE (routes/events.ts:20): head/block/finalized stream
         r("GET", "/eth/v1/events", self._events)
+        # subnet subscriptions (routes/validator.ts prepareBeaconCommitteeSubnet)
+        r("POST", "/eth/v1/validator/beacon_committee_subscriptions", self._committee_subs)
+        r("POST", "/eth/v1/validator/sync_committee_subscriptions", self._sync_subs)
         r("GET", "/metrics", self._metrics)
 
     def _state_for(self, state_id: str):
@@ -239,6 +242,41 @@ class RestApiServer:
                 raise ApiError(404, "state not found")
             return st
         raise ApiError(400, f"unsupported state id {state_id}")
+
+    def _committee_subs(self, pp, q, b):
+        """AttnetsService feed (subnets/attnetsService.ts committee subs).
+        subnet = (committees_since_epoch_start + committee_index) %
+        ATTESTATION_SUBNET_COUNT (spec compute_subnet_for_attestation)."""
+        if self.network is None:
+            return {}
+        from ..params.presets import ATTESTATION_SUBNET_COUNT
+
+        for sub in b or []:
+            slot = int(sub["slot"])
+            committee_index = int(sub["committee_index"])
+            committees_at_slot = int(sub.get("committees_at_slot", 1))
+            slots_since_start = slot % self.p.SLOTS_PER_EPOCH
+            subnet = (
+                committees_at_slot * slots_since_start + committee_index
+            ) % ATTESTATION_SUBNET_COUNT
+            self.network.attnets.add_committee_subscription(subnet, until_slot=slot + 1)
+            if "validator_index" in sub:
+                self.network.attnets.add_validator(int(sub["validator_index"]))
+        return {}
+
+    def _sync_subs(self, pp, q, b):
+        if self.network is None:
+            return {}
+        for sub in b or []:
+            until = int(sub.get("until_epoch", 0)) * self.p.SLOTS_PER_EPOCH
+            for idx in sub.get("sync_committee_indices", []):
+                from ..chain.sync_committee_pools import SYNC_COMMITTEE_SUBNET_COUNT
+
+                sub_size = self.p.SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+                self.network.syncnets.add_subscription(
+                    int(idx) // sub_size, until_slot=until
+                )
+        return {}
 
     def _events(self, pp, q, b):
         """SSE stream of chain events (routes/events.ts:20).  ?topics=

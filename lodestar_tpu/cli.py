@@ -86,6 +86,21 @@ def build_parser() -> argparse.ArgumentParser:
     vc.add_argument("--interop-indices", default="0..15",
                     help="interop key range, e.g. 0..15")
     vc.add_argument("--slashing-protection-db", help="EIP-3076 JSON path")
+    vc.add_argument("--keystores-dir",
+                    help="directory of EIP-2335 keystore-*.json files "
+                    "(overrides --interop-indices; cmds/account import flow)")
+    vc.add_argument("--keystores-password-file",
+                    help="file holding the shared keystore password")
+
+    acct = sub.add_parser("account", help="keystore management (cmds/account)")
+    acct_sub = acct.add_subparsers(dest="account_cmd", required=True)
+    acct_create = acct_sub.add_parser("create", help="generate EIP-2335 keystores")
+    acct_create.add_argument("--out-dir", required=True)
+    acct_create.add_argument("--password-file", required=True)
+    acct_create.add_argument("--count", type=int, default=1)
+    acct_create.add_argument("--kdf", choices=("scrypt", "pbkdf2"), default="pbkdf2")
+    acct_list = acct_sub.add_parser("list", help="list keystore pubkeys")
+    acct_list.add_argument("--keystores-dir", required=True)
 
     lc = sub.add_parser("lightclient", help="light client (cmds/lightclient)")
     lc.add_argument("--beacon-url", default="http://127.0.0.1:9596")
@@ -261,12 +276,51 @@ async def run_validator(args) -> int:
     cfg = ChainConfig(PRESET_BASE=args.preset, MIN_GENESIS_TIME=0,
                       SHARD_COMMITTEE_PERIOD=0,
                       MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16)
-    lo, _, hi = args.interop_indices.partition("..")
-    keys = {i: interop_secret_key(i) for i in range(int(lo), int(hi) + 1)}
     url = args.beacon_url.rstrip("/")
     host = url.split("//")[-1].split(":")[0]
     port = int(url.rsplit(":", 1)[-1])
     api = ApiClient(host, port)
+    if args.keystores_dir:
+        from .crypto.bls.api import SecretKey
+        from .validator.keystore import load_keystores_dir
+
+        password = ""
+        if args.keystores_password_file:
+            password = open(args.keystores_password_file).read().strip()
+        loaded = load_keystores_dir(args.keystores_dir, password)
+        if not loaded:
+            logger.error("no keystores found in %s", args.keystores_dir)
+            return 1
+        # resolve validator indices over the API (IndicesService role,
+        # validator/src/services/indices.ts:17); unresolved pubkeys stay
+        # pending and are retried every epoch — a not-yet-activated key
+        # must start signing the moment it activates, not never
+        keys = {}
+        pending_secrets = {pk: SecretKey.from_bytes(sec) for pk, sec in loaded.items()}
+
+        async def resolve_pending(store=None):
+            for pk in list(pending_secrets):
+                try:
+                    info = await api.get(
+                        f"/eth/v1/beacon/states/head/validators/0x{pk.hex()}"
+                    )
+                    idx = int(info["data"]["index"])
+                except Exception:
+                    continue
+                sk = pending_secrets.pop(pk)
+                keys[idx] = sk
+                if store is not None:
+                    store.keys[idx] = sk
+                    store.pubkeys[idx] = pk
+                logger.info("validator 0x%s... resolved to index %d", pk.hex()[:12], idx)
+
+        await resolve_pending()
+        if pending_secrets:
+            logger.warning("%d keystore pubkeys not yet active; will retry", len(pending_secrets))
+        logger.info("loaded %d keystore validators", len(keys))
+    else:
+        lo, _, hi = args.interop_indices.partition("..")
+        keys = {i: interop_secret_key(i) for i in range(int(lo), int(hi) + 1)}
     # persist_path: every accepted record is WAL'd before the signature is
     # released, so a crash/SIGKILL cannot lose signing history (ADVICE r3)
     protection = SlashingProtection(persist_path=args.slashing_protection_db)
@@ -286,6 +340,8 @@ async def run_validator(args) -> int:
             syncing = await api.get("/eth/v1/node/syncing")
             head = int(syncing["data"]["head_slot"])
             slot = max(slot, head + 1)
+            if args.keystores_dir and pending_secrets and slot % 8 == 0:
+                await resolve_pending(store)
             # wait up to 1/3 slot for the head event before attesting
             await vc.run_slot(slot, head_wait_s=cfg.SECONDS_PER_SLOT / 3)
             slot += 1
@@ -351,6 +407,37 @@ async def run_lightclient(args) -> int:
     return 0
 
 
+def run_account(args) -> int:
+    """Keystore management (reference cmds/account: create/list)."""
+    import json as _json
+    import os as _os
+    import secrets as _secrets
+
+    from .validator.keystore import create_keystore
+
+    if args.account_cmd == "create":
+        password = open(args.password_file).read().strip()
+        _os.makedirs(args.out_dir, exist_ok=True)
+        from .crypto.bls.fields import R as _R
+
+        for i in range(args.count):
+            secret = (int.from_bytes(_secrets.token_bytes(32), "big") % (_R - 1) + 1).to_bytes(32, "big")
+            ks = create_keystore(secret, password, kdf=args.kdf)
+            path = _os.path.join(args.out_dir, f"keystore-{ks['pubkey'][:12]}.json")
+            with open(path, "w") as f:
+                _json.dump(ks, f, indent=2)
+            print(f"wrote {path}")
+        return 0
+    if args.account_cmd == "list":
+        for name in sorted(_os.listdir(args.keystores_dir)):
+            if name.endswith(".json"):
+                with open(_os.path.join(args.keystores_dir, name)) as f:
+                    ks = _json.load(f)
+                print(f"0x{ks.get('pubkey', '?')}  {name}")
+        return 0
+    return 2
+
+
 def main(argv: Optional[list] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.cmd == "dev":
@@ -361,6 +448,8 @@ def main(argv: Optional[list] = None) -> int:
         return asyncio.run(run_validator(args))
     if args.cmd == "lightclient":
         return asyncio.run(run_lightclient(args))
+    if args.cmd == "account":
+        return run_account(args)
     return 2
 
 

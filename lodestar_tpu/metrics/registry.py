@@ -95,6 +95,15 @@ class Metrics:
             "device dispatch latency",
             buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5),
         )
+        # validator monitor (metrics/validatorMonitor.ts)
+        self.monitor_proposals_total = r.counter(
+            "lodestar_validator_monitor_proposals_total",
+            "blocks proposed by registered validators",
+        )
+        self.monitor_attestation_hit_ratio = r.gauge(
+            "lodestar_validator_monitor_attestation_hit_ratio",
+            "fraction of registered validators attesting per epoch",
+        )
         self.bls_pool_job_wait_seconds = r.histogram(
             "lodestar_bls_pool_job_wait_seconds",
             "time a set waits in the buffer before dispatch",

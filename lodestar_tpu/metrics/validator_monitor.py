@@ -1,0 +1,104 @@
+"""ValidatorMonitor: opt-in per-validator duty tracking inside the node.
+
+Reference: packages/beacon-node/src/metrics/validatorMonitor.ts:165 —
+operators register the indices they care about; the node then records,
+per epoch, whether each one attested (and with what inclusion delay) and
+proposed, surfacing hit-rates through the metrics registry and epoch
+summaries through logs.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional, Set
+
+from ..params import Preset
+from ..state_transition import compute_epoch_at_slot
+from ..utils.logger import get_logger
+
+logger = get_logger("validator-monitor")
+
+
+class ValidatorMonitor:
+    def __init__(self, preset: Preset, metrics=None):
+        self.p = preset
+        self.metrics = metrics
+        self.registered: Set[int] = set()
+        # epoch -> index -> min inclusion delay of an included attestation
+        self._att_inclusion: Dict[int, Dict[int, int]] = defaultdict(dict)
+        # epoch -> set of registered proposers who proposed
+        self._proposals: Dict[int, Set[int]] = defaultdict(set)
+        self._last_summarized_epoch = -1
+
+    def register_local_validator(self, index: int) -> None:
+        self.registered.add(int(index))
+
+    # -- feed (called from BeaconChain on import) ----------------------------
+
+    def on_block(self, block, ctx) -> None:
+        """Record proposals by, and attestation inclusions of, registered
+        validators (validatorMonitor registerBeaconBlock +
+        registerAttestationInBlock)."""
+        if not self.registered:
+            return
+        if int(block.proposer_index) in self.registered:
+            epoch = compute_epoch_at_slot(self.p, block.slot)
+            self._proposals[epoch].add(int(block.proposer_index))
+            if self.metrics:
+                self.metrics.monitor_proposals_total.inc()
+        for att in block.body.attestations:
+            data = att.data
+            try:
+                indices = ctx.get_attesting_indices(data, att.aggregation_bits)
+            except Exception:
+                continue
+            delay = max(1, int(block.slot) - int(data.slot))
+            epoch = data.target.epoch
+            for vi in indices:
+                vi = int(vi)
+                if vi not in self.registered:
+                    continue
+                prev = self._att_inclusion[epoch].get(vi)
+                if prev is None or delay < prev:
+                    self._att_inclusion[epoch][vi] = delay
+
+    def on_clock_epoch(self, epoch: int) -> None:
+        """Summarize the epoch before last (its inclusions are final) —
+        the reference's onceEveryEndOfEpoch summary."""
+        done = epoch - 2
+        if done < 0 or done <= self._last_summarized_epoch:
+            return
+        self._last_summarized_epoch = done
+        summary = self.epoch_summary(done)
+        if summary is None:
+            return
+        logger.info(
+            "epoch %d: %d/%d registered validators attested (avg delay %.2f)",
+            done, summary["attested"], summary["registered"],
+            summary["avg_inclusion_delay"],
+        )
+        if self.metrics:
+            self.metrics.monitor_attestation_hit_ratio.set(
+                summary["attested"] / max(1, summary["registered"])
+            )
+        # prune old epochs
+        for e in [e for e in self._att_inclusion if e < done - 2]:
+            del self._att_inclusion[e]
+        for e in [e for e in self._proposals if e < done - 2]:
+            del self._proposals[e]
+
+    # -- queries -------------------------------------------------------------
+
+    def epoch_summary(self, epoch: int) -> Optional[dict]:
+        if not self.registered:
+            return None
+        inc = self._att_inclusion.get(epoch, {})
+        delays = [d for vi, d in inc.items()]
+        return {
+            "epoch": epoch,
+            "registered": len(self.registered),
+            "attested": len(inc),
+            "missed": sorted(self.registered - set(inc)),
+            "avg_inclusion_delay": (sum(delays) / len(delays)) if delays else 0.0,
+            "proposals": sorted(self._proposals.get(epoch, ())),
+        }

@@ -52,6 +52,24 @@ class Network:
         self.peer_manager = PeerManager()
         self.score_store = PeerRpcScoreStore()
         self.router = GossipRouter(on_reject=self._on_gossip_reject)
+        # subnet services + seq-numbered metadata (SURVEY §2.5 attnets/
+        # syncnets; served to peers over reqresp METADATA)
+        from .subnets import AttnetsService, MetadataController, SyncnetsService
+
+        self.metadata = MetadataController()
+        self.attnets = AttnetsService(preset, self.metadata)
+        self.syncnets = SyncnetsService(preset, self.metadata)
+        # chain progress ticks the subnet services (rotation + expiry);
+        # committee/sync subscriptions arrive via the REST routes
+        from ..chain.emitter import ChainEvent
+
+        chain.emitter.on(
+            ChainEvent.BLOCK,
+            lambda sb, _root: (
+                self.attnets.on_slot(sb.message.slot),
+                self.syncnets.on_slot(sb.message.slot),
+            ),
+        )
         self._server: Optional[asyncio.AbstractServer] = None
         self._peer_seq = 0
         self.t = get_types(preset).phase0
@@ -102,7 +120,7 @@ class Network:
             writer.close()
             raise ConnectionRefusedError(f"peer {remote_key} is banned")
         wire = Wire(reader, writer)
-        reqresp = ReqRespNode(self.p, self.chain, wire)
+        reqresp = ReqRespNode(self.p, self.chain, wire, metadata=self.metadata)
         peer = Peer(peer_id=peer_id, reqresp=reqresp, wire=wire, remote_key=remote_key)
 
         async def gossip_send(topic: str, ssz_bytes: bytes) -> None:

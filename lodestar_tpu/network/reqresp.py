@@ -20,6 +20,7 @@ from .wire import (
     KIND_RESPONSE_CHUNK,
     KIND_RESPONSE_END,
     RESULT_INVALID_REQUEST,
+    RESULT_RATE_LIMITED,
     RESULT_SERVER_ERROR,
     RESULT_SUCCESS,
     Wire,
@@ -52,6 +53,37 @@ MAX_RESPONSE_CHUNKS = {
 MAX_RESPONSE_TOTAL_BYTES = 128 * 1024 * 1024
 
 
+class RateTracker:
+    """Sliding-window quota (reqresp/rateTracker.ts:14): N units per
+    60-second window.  requestCount and objectCount (blocks served) are
+    tracked separately per peer connection."""
+
+    def __init__(self, limit: int, window_s: float = 60.0):
+        self.limit = limit
+        self.window_s = window_s
+        self._events: List[tuple] = []  # (monotonic_time, units)
+
+    def request_units(self, units: int = 1) -> bool:
+        """True if the quota admits `units` more; records them if so."""
+        import time as _t
+
+        now = _t.monotonic()
+        cutoff = now - self.window_s
+        while self._events and self._events[0][0] < cutoff:
+            self._events.pop(0)
+        used = sum(u for _, u in self._events)
+        if used + units > self.limit:
+            return False
+        self._events.append((now, units))
+        return True
+
+
+# per-peer-connection quotas (reference requestCountPeerLimit=50/min,
+# blockCountPeerLimit=500/min)
+REQUEST_COUNT_PER_MINUTE = 50
+BLOCK_COUNT_PER_MINUTE = 500
+
+
 class RequestError(Exception):
     def __init__(self, result: int, message: str = ""):
         super().__init__(f"reqresp error {result}: {message}")
@@ -65,13 +97,18 @@ class ReqRespNode:
     blocks from the hot db + archive (handlers/beaconBlocksByRange.ts).
     """
 
-    def __init__(self, preset: Preset, chain, wire: Wire):
+    def __init__(self, preset: Preset, chain, wire: Wire, metadata=None):
         self.p = preset
         self.chain = chain
         self.t = get_types(preset).phase0
         self.wire = wire
+        self.metadata_controller = metadata  # network/metadata.ts source
         self._req_ids = itertools.count(1)
         self._pending: Dict[int, asyncio.Queue] = {}
+        # server-side quotas for THIS peer (rateTracker.ts)
+        self.request_rate = RateTracker(REQUEST_COUNT_PER_MINUTE)
+        self.block_rate = RateTracker(BLOCK_COUNT_PER_MINUTE)
+        self.rate_limited_count = 0
 
     # -- client side -----------------------------------------------------------
 
@@ -200,6 +237,9 @@ class ReqRespNode:
         await self.wire.send_frame(KIND_RESPONSE_END, Wire.encode_response_end(req_id))
 
     async def _serve(self, method: int, body: bytes) -> List[bytes]:
+        if not self.request_rate.request_units(1):
+            self.rate_limited_count += 1
+            raise RequestError(RESULT_RATE_LIMITED, "request quota exceeded")
         if method == METHOD_STATUS:
             return [self.t.Status.serialize(self.local_status())]
         if method == METHOD_GOODBYE:
@@ -208,21 +248,32 @@ class ReqRespNode:
             seq = self.t.Ping.deserialize(body)
             return [self.t.Ping.serialize(seq)]
         if method == METHOD_METADATA:
+            mc = self.metadata_controller
             return [
                 self.t.Metadata.serialize(
-                    _fields(seq_number=0, attnets=[False] * 64)
+                    _fields(
+                        seq_number=mc.seq_number if mc else 0,
+                        attnets=list(mc.attnets) if mc else [False] * 64,
+                    )
                 )
             ]
         if method == METHOD_BLOCKS_BY_RANGE:
             req = self.t.BeaconBlocksByRangeRequest.deserialize(body)
             if req.count > MAX_REQUEST_BLOCKS or req.step < 1:
                 raise RequestError(RESULT_INVALID_REQUEST, "bad range request")
+            # block quota charges objects served, not requests (rateTracker.ts)
+            if not self.block_rate.request_units(max(1, int(req.count))):
+                self.rate_limited_count += 1
+                raise RequestError(RESULT_RATE_LIMITED, "block quota exceeded")
             return [
                 self._encode_block(b)
                 for b in self._blocks_in_range(req.start_slot, req.count, req.step)
             ]
         if method == METHOD_BLOCKS_BY_ROOT:
             req = self.t.BeaconBlocksByRootRequest.deserialize(body)
+            if not self.block_rate.request_units(max(1, len(req.roots))):
+                self.rate_limited_count += 1
+                raise RequestError(RESULT_RATE_LIMITED, "block quota exceeded")
             out = []
             for root in req.roots[:MAX_REQUEST_BLOCKS]:
                 blk = self.chain.get_block_by_root(bytes(root))

@@ -29,6 +29,7 @@ KIND_GOSSIP = 0x04
 RESULT_SUCCESS = 0
 RESULT_INVALID_REQUEST = 1
 RESULT_SERVER_ERROR = 2
+RESULT_RATE_LIMITED = 3  # spec ResourceUnavailable class
 
 MAX_PAYLOAD = 32 * 1024 * 1024
 # decompressed-size bound for any single wire message: matches the spec's

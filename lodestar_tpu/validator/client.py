@@ -91,6 +91,25 @@ class ValidatorClient:
         self._proposer_duties[epoch] = [
             d for d in prop["data"] if d["validator_index"] in ours
         ]
+        # advertise committee subnets for the polled duties (the
+        # AttnetsService feed, services/attestationDuties.ts subscriptions)
+        subs = [
+            {
+                "validator_index": d["validator_index"],
+                "committee_index": d["committee_index"],
+                "committees_at_slot": d.get("committees_at_slot", 1),
+                "slot": d["slot"],
+                "is_aggregator": True,
+            }
+            for d in att["data"]
+        ]
+        if subs:
+            try:
+                await self.api.post(
+                    "/eth/v1/validator/beacon_committee_subscriptions", subs
+                )
+            except Exception:  # noqa: BLE001 - advertisement is best-effort
+                pass
 
     # -- block production ------------------------------------------------------
 

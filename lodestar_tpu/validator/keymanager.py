@@ -1,0 +1,174 @@
+"""Keymanager API server: the standard key-management namespace served by
+the validator-client process.
+
+Reference: packages/api/src/keymanager/routes.ts (the
+eth/v1/keystores list/import/delete surface of the keymanager-APIs spec)
++ the reference VC's keymanager server.  Import/delete integrate the
+EIP-2335 codec (validator/keystore.py) and the EIP-3076 slashing
+interchange so a migrating operator carries protection history with the
+keys.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+from typing import Callable, Dict, List, Optional, Tuple
+from urllib.parse import urlparse
+
+from ..crypto.bls.api import SecretKey
+from ..utils.logger import get_logger
+from .keystore import KeystoreError, decrypt_keystore
+from .slashing_protection import SlashingProtection
+
+logger = get_logger("keymanager")
+
+
+class KeymanagerApi:
+    """Route logic, server-agnostic (testable without sockets)."""
+
+    def __init__(self, store, protection: SlashingProtection, index_resolver=None):
+        self.store = store  # ValidatorStore
+        self.protection = protection
+        # pubkey -> validator index; None = unknown (not yet activated)
+        self.index_resolver = index_resolver or (lambda pk: None)
+
+    def list_keystores(self) -> dict:
+        data = [
+            {
+                "validating_pubkey": "0x" + pk.hex(),
+                "derivation_path": "",
+                "readonly": False,
+            }
+            for pk in sorted(self.store.pubkeys.values())
+        ]
+        return {"data": data}
+
+    def import_keystores(self, body: dict) -> dict:
+        keystores = body.get("keystores", [])
+        passwords = body.get("passwords", [])
+        interchange = body.get("slashing_protection")
+        if interchange:
+            self.protection.import_interchange(
+                json.loads(interchange) if isinstance(interchange, str) else interchange
+            )
+        statuses = []
+        for raw, password in zip(keystores, passwords):
+            try:
+                ks = json.loads(raw) if isinstance(raw, str) else raw
+                secret = decrypt_keystore(ks, password)
+                sk = SecretKey.from_bytes(secret)
+                pk = sk.to_public_key().to_bytes()
+                if pk in self.store.pubkeys.values():
+                    statuses.append({"status": "duplicate", "message": ""})
+                    continue
+                idx = self.index_resolver(pk)
+                if idx is None:
+                    # keep the key under a synthetic negative index until
+                    # it activates; signing paths resolve by index so an
+                    # unknown validator simply has no duties yet
+                    idx = -(len(self.store.keys) + 1)
+                self.store.keys[idx] = sk
+                self.store.pubkeys[idx] = pk
+                statuses.append({"status": "imported", "message": ""})
+            except (KeystoreError, ValueError, KeyError) as e:
+                statuses.append({"status": "error", "message": str(e)})
+        return {"data": statuses}
+
+    def delete_keystores(self, body: dict) -> dict:
+        wanted = {bytes.fromhex(pk[2:]) for pk in body.get("pubkeys", [])}
+        statuses = []
+        for pk in body.get("pubkeys", []):
+            raw = bytes.fromhex(pk[2:])
+            idx = next((i for i, p in self.store.pubkeys.items() if p == raw), None)
+            if idx is None:
+                statuses.append({"status": "not_found", "message": ""})
+                continue
+            del self.store.keys[idx]
+            del self.store.pubkeys[idx]
+            statuses.append({"status": "deleted", "message": ""})
+        # export the whole protection history for the deleted keys' owner
+        # (keymanager spec: the response carries the interchange)
+        return {
+            "data": statuses,
+            "slashing_protection": json.dumps(self.protection.export_interchange()),
+        }
+
+
+class KeymanagerServer:
+    """Minimal asyncio HTTP host for the keymanager routes (the VC-side
+    analog of BeaconRestApiServer; bearer-token auth like the reference's
+    keymanager server)."""
+
+    def __init__(self, api: KeymanagerApi, token: Optional[str] = None, host: str = "127.0.0.1"):
+        self.api = api
+        self.token = token
+        self.host = host
+        self.port: Optional[int] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def listen(self, port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._conn, self.host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("keymanager API on http://%s:%d", self.host, self.port)
+        return self.port
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _conn(self, reader, writer):
+        try:
+            line = await reader.readline()
+            if not line:
+                return
+            method, target, _ = line.decode().split()
+            headers = {}
+            while True:
+                h = await reader.readline()
+                if h in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = h.decode().partition(":")
+                headers[k.strip().lower()] = v.strip()
+            body = b""
+            if "content-length" in headers:
+                body = await reader.readexactly(int(headers["content-length"]))
+            status, payload = self._dispatch(method, urlparse(target).path, headers, body)
+            data = json.dumps(payload).encode()
+            writer.write(
+                b"HTTP/1.1 %d %s\r\ncontent-type: application/json\r\n"
+                % (status, b"OK" if status < 400 else b"Error")
+                + b"content-length: %d\r\n\r\n" % len(data)
+                + data
+            )
+            await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def _dispatch(self, method: str, path: str, headers: dict, body: bytes):
+        if self.token:
+            auth = headers.get("authorization", "")
+            if auth != f"Bearer {self.token}":
+                return 401, {"code": 401, "message": "missing or bad bearer token"}
+        try:
+            parsed = json.loads(body) if body else {}
+        except ValueError:
+            return 400, {"code": 400, "message": "bad json"}
+        try:
+            if path == "/eth/v1/keystores":
+                if method == "GET":
+                    return 200, self.api.list_keystores()
+                if method == "POST":
+                    return 200, self.api.import_keystores(parsed)
+                if method == "DELETE":
+                    return 200, self.api.delete_keystores(parsed)
+            return 404, {"code": 404, "message": f"no route {method} {path}"}
+        except Exception as e:  # noqa: BLE001
+            return 500, {"code": 500, "message": str(e)}

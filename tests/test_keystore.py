@@ -1,0 +1,107 @@
+"""EIP-2335 keystore tests: official spec vectors + round-trips.
+
+The two KAT keystores are the EIP-2335 specification's own test vectors
+(scrypt and pbkdf2, same secret/password/salt/iv).
+"""
+
+import json
+
+import pytest
+
+from lodestar_tpu.validator.keystore import (
+    KeystoreError,
+    aes128_ctr,
+    create_keystore,
+    decrypt_keystore,
+    load_keystores_dir,
+)
+
+EIP2335_PASSWORD = "\U0001d531\U0001d522\U0001d530\U0001d531\U0001d52d\U0001d51e\U0001d530\U0001d530\U0001d534\U0001d52c\U0001d52f\U0001d521\U0001f511"
+EIP2335_SECRET = bytes.fromhex(
+    "000000000019d6689c085ae165831e934ff763ae46a2a6c172b3f1b60a8ce26f"
+)
+
+SCRYPT_VECTOR = {
+    "crypto": {
+        "kdf": {
+            "function": "scrypt",
+            "params": {
+                "dklen": 32, "n": 262144, "p": 1, "r": 8,
+                "salt": "d4e56740f876aef8c010b86a40d5f56745a118d0906a34e69aec8c0db1cb8fa3",
+            },
+            "message": "",
+        },
+        "checksum": {
+            "function": "sha256", "params": {},
+            "message": "d2217fe5f3e9a1e34581ef8a78f7c9928e436d36dacc5e846690a5581e8ea484",
+        },
+        "cipher": {
+            "function": "aes-128-ctr",
+            "params": {"iv": "264daa3f303d7259501c93d997d84fe6"},
+            "message": "06ae90d55fe0a6e9c5c3bc5b170827b2e5cce3929ed3f116c2811e6366dfe20f",
+        },
+    },
+    "description": "This is a test keystore that uses scrypt to secure the secret.",
+    "pubkey": "9612d7a727c9d0a22e185a1c768478dfe919cada9266988cb32359c11f2b7b27f4ae4040902382ae2910c15e2b420d07",
+    "path": "m/12381/60/3141592653/589793238",
+    "version": 4,
+}
+
+PBKDF2_VECTOR = {
+    "crypto": {
+        "kdf": {
+            "function": "pbkdf2",
+            "params": {
+                "dklen": 32, "c": 262144, "prf": "hmac-sha256",
+                "salt": "d4e56740f876aef8c010b86a40d5f56745a118d0906a34e69aec8c0db1cb8fa3",
+            },
+            "message": "",
+        },
+        "checksum": {
+            "function": "sha256", "params": {},
+            "message": "8a9f5d9912ed7e75ea794bc5a89bca5f193721d30868ade6f73043c6ea6febf1",
+        },
+        "cipher": {
+            "function": "aes-128-ctr",
+            "params": {"iv": "264daa3f303d7259501c93d997d84fe6"},
+            "message": "cee03fde2af33149775b7223e7845e4fb2c8ae1792e5f99fe9ecf474cc8c16ad",
+        },
+    },
+    "description": "This is a test keystore that uses PBKDF2 to secure the secret.",
+    "pubkey": "9612d7a727c9d0a22e185a1c768478dfe919cada9266988cb32359c11f2b7b27f4ae4040902382ae2910c15e2b420d07",
+    "path": "m/12381/60/0/0",
+    "version": 4,
+}
+
+
+def test_aes128_ctr_fips_kat():
+    # NIST SP 800-38A F.5.1 CTR-AES128.Encrypt, block 1
+    key = bytes.fromhex("2b7e151628aed2a6abf7158809cf4f3c")
+    iv = bytes.fromhex("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff")
+    pt = bytes.fromhex("6bc1bee22e409f96e93d7e117393172a")
+    assert aes128_ctr(key, iv, pt).hex() == "874d6191b620e3261bef6864990db6ce"
+
+
+def test_eip2335_scrypt_vector():
+    assert decrypt_keystore(SCRYPT_VECTOR, EIP2335_PASSWORD) == EIP2335_SECRET
+
+
+def test_eip2335_pbkdf2_vector():
+    assert decrypt_keystore(PBKDF2_VECTOR, EIP2335_PASSWORD) == EIP2335_SECRET
+
+
+def test_wrong_password_rejected():
+    with pytest.raises(KeystoreError, match="checksum"):
+        decrypt_keystore(SCRYPT_VECTOR, "wrong")
+
+
+def test_create_and_reload_roundtrip(tmp_path):
+    secret = bytes(range(32))
+    ks = create_keystore(secret, "hunter2hunter2", kdf="pbkdf2")
+    assert decrypt_keystore(ks, "hunter2hunter2") == secret
+    # directory loading (account-manager import flow)
+    (tmp_path / "keystore-0.json").write_text(json.dumps(ks))
+    loaded = load_keystores_dir(str(tmp_path), "hunter2hunter2")
+    assert list(loaded.values()) == [secret]
+    pk = next(iter(loaded))
+    assert len(pk) == 48

@@ -1,0 +1,94 @@
+"""Attnets/syncnets services + metadata rotation (attnetsService.ts:31,
+network/metadata.ts; SURVEY component 28)."""
+
+import asyncio
+
+from lodestar_tpu.network.subnets import (
+    EPOCHS_PER_RANDOM_SUBNET_SUBSCRIPTION,
+    AttnetsService,
+    MetadataController,
+    SyncnetsService,
+)
+from lodestar_tpu.params import MINIMAL
+
+
+def test_long_lived_subnets_rotate_and_bump_metadata():
+    md = MetadataController()
+    svc = AttnetsService(MINIMAL, md, node_seed=b"\x01" * 8)
+    assert md.seq_number == 0
+    svc.add_validator(5)
+    assert md.seq_number == 1
+    assert len(svc.active_subnets()) == 1
+    first = svc.active_subnets()
+    # stable within the subscription period
+    svc.on_slot(10 * MINIMAL.SLOTS_PER_EPOCH)
+    assert svc.active_subnets() == first
+    # rotates across a full period boundary for this validator
+    rotated = False
+    for epochs in range(0, 3 * EPOCHS_PER_RANDOM_SUBNET_SUBSCRIPTION, 16):
+        svc.on_slot(epochs * MINIMAL.SLOTS_PER_EPOCH)
+        if svc.active_subnets() != first:
+            rotated = True
+            break
+    assert rotated, "random subnet never rotated across periods"
+
+
+def test_committee_subscriptions_expire():
+    md = MetadataController()
+    svc = AttnetsService(MINIMAL, md)
+    svc.add_committee_subscription(7, until_slot=20)
+    assert svc.should_process(7)
+    assert md.attnets[7] is True
+    seq = md.seq_number
+    svc.on_slot(21)
+    assert not svc.should_process(7)
+    assert md.attnets[7] is False
+    assert md.seq_number > seq
+
+
+def test_syncnets_and_metadata_served_over_reqresp():
+    async def main():
+        from lodestar_tpu.chain.bls_pool import BlsBatchPool
+        from lodestar_tpu.chain.handlers import GossipHandlers
+        from lodestar_tpu.config.chain_config import ChainConfig
+        from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+        from lodestar_tpu.network import Network
+        from lodestar_tpu.node.dev_chain import DevChain
+
+        cfg = ChainConfig(
+            PRESET_BASE="minimal", SHARD_COMMITTEE_PERIOD=0, MIN_GENESIS_TIME=0,
+            MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
+            ALTAIR_FORK_EPOCH=2**64 - 1, BELLATRIX_FORK_EPOCH=2**64 - 1,
+        )
+        pool_a = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool_b = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        a = DevChain(MINIMAL, cfg, 16, pool_a)
+        b = DevChain(MINIMAL, cfg, 16, pool_b)
+        net_a = Network(MINIMAL, a.chain, GossipHandlers(a.chain))
+        net_b = Network(MINIMAL, b.chain, GossipHandlers(b.chain))
+        # A advertises two attnets before any connection
+        net_a.attnets.add_committee_subscription(3, until_slot=100)
+        net_a.attnets.add_committee_subscription(9, until_slot=100)
+        port = await net_a.listen(0)
+        peer = await net_b.connect("127.0.0.1", port)
+        md = await peer.reqresp.metadata()
+        assert md.seq_number == net_a.metadata.seq_number
+        assert list(md.attnets)[3] is True and list(md.attnets)[9] is True
+        assert sum(md.attnets) == 2
+        await net_b.close()
+        await net_a.close()
+        pool_a.close()
+        pool_b.close()
+
+    asyncio.run(main())
+
+
+def test_syncnets_service():
+    md = MetadataController()
+    svc = SyncnetsService(MINIMAL, md)
+    svc.add_subscription(2, until_slot=50)
+    assert svc.active_subnets() == {2}
+    assert md.syncnets == [False, False, True, False]
+    svc.on_slot(51)
+    assert svc.active_subnets() == set()
+    assert md.syncnets == [False] * 4

@@ -61,3 +61,26 @@ def test_malformed_frames_do_not_kill_the_node():
         pool.close()
 
     asyncio.run(main())
+
+
+def test_reqresp_rate_limiting():
+    """Server-side quotas (rateTracker.ts): a peer hammering requests gets
+    RESULT_RATE_LIMITED instead of service."""
+    from lodestar_tpu.network.reqresp import RateTracker
+
+    rt = RateTracker(limit=3, window_s=60.0)
+    assert rt.request_units(1) and rt.request_units(1) and rt.request_units(1)
+    assert not rt.request_units(1)  # over quota
+    # block-count charging: one big request can exhaust the block quota
+    bt = RateTracker(limit=100, window_s=60.0)
+    assert bt.request_units(64)
+    assert not bt.request_units(64)
+    assert bt.request_units(36)
+    # window expiry frees quota
+    rt2 = RateTracker(limit=1, window_s=0.05)
+    assert rt2.request_units(1)
+    assert not rt2.request_units(1)
+    import time
+
+    time.sleep(0.06)
+    assert rt2.request_units(1)
