@@ -74,6 +74,10 @@ def build_parser() -> argparse.ArgumentParser:
     beacon = sub.add_parser("beacon", help="beacon node (cmds/beacon)")
     common(beacon)
     beacon.add_argument("--genesis-state", help="SSZ genesis state file")
+    beacon.add_argument("--discovery-port", type=int, default=None,
+                        help="UDP discovery port (0 = ephemeral; omit to disable)")
+    beacon.add_argument("--bootnode", action="append", default=[],
+                        help="discovery bootstrap host:udp_port (repeatable)")
     beacon.add_argument(
         "--checkpoint-sync-url",
         help="trusted beacon REST URL to fetch the finalized state from "
@@ -242,6 +246,20 @@ async def run_beacon(args) -> int:
     rest = RestApiServer(preset, chain, network=network)
     rest.gossip_handlers = handlers
     await rest.listen(args.rest_port)
+    if args.discovery_port is not None:
+        from .crypto.bls.api import SecretKey as _SK
+        import secrets as _secrets
+
+        from .crypto.bls.fields import R as _R
+
+        identity = _SK.from_bytes(
+            (int.from_bytes(_secrets.token_bytes(32), "big") % (_R - 1) + 1).to_bytes(32, "big")
+        )
+        boots = []
+        for b in args.bootnode:
+            bh, _, bp = b.partition(":")
+            boots.append((bh, int(bp)))
+        await network.enable_discovery(identity, args.discovery_port, bootstrap=boots)
     backfill_task = None
     if anchor_block_root is not None:
         from .sync.backfill import BackfillSync

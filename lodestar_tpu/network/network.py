@@ -72,9 +72,42 @@ class Network:
         )
         self._server: Optional[asyncio.AbstractServer] = None
         self._peer_seq = 0
+        self.discovery = None
         self.t = get_types(preset).phase0
         if gossip_handlers is not None:
             self._subscribe_core_topics()
+
+    # -- discovery (peers/discover.ts role) ------------------------------------
+
+    async def enable_discovery(
+        self, identity, udp_port: int = 0, bootstrap=()
+    ) -> int:
+        """Start the UDP discovery service; newly discovered records are
+        dialed while the peer count is below max_peers."""
+        from .discovery import DiscoveryService
+
+        def on_peer(rec) -> None:
+            if len(self.peer_manager.peers) >= self.peer_manager.max_peers:
+                return
+            if self.score_store.state(str(rec.ip)) == ScoreState.BANNED:
+                return
+            logger.info("discovered peer %s:%d; dialing", rec.ip, rec.tcp_port)
+            asyncio.ensure_future(self._dial_discovered(rec))
+
+        self.discovery = DiscoveryService(
+            identity, tcp_port=self.port or 0, host=self.host, on_peer=on_peer
+        )
+        port = await self.discovery.listen(udp_port)
+        for host, bport in bootstrap:
+            self.discovery.add_bootstrap(host, bport)
+        self.discovery.start_lookups()
+        return port
+
+    async def _dial_discovered(self, rec) -> None:
+        try:
+            await self.connect(rec.ip, rec.tcp_port)
+        except Exception as e:  # noqa: BLE001
+            logger.debug("dial of discovered peer failed: %s", e)
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -89,6 +122,8 @@ class Network:
         return await self._setup_peer(reader, writer, initiator=True)
 
     async def close(self) -> None:
+        if self.discovery is not None:
+            await self.discovery.close()
         for peer in self.peer_manager.connected():
             await self._drop_peer(peer, goodbye=True)
         if self._server is not None:

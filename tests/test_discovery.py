@@ -1,0 +1,115 @@
+"""Discovery: signed node records, FINDNODE propagation, and the
+network integration that dials discovered peers (peers/discover.ts role;
+VERDICT r3 missing item 3)."""
+
+import asyncio
+
+from lodestar_tpu.crypto.bls.api import interop_secret_key
+from lodestar_tpu.network.discovery import DiscoveryService, NodeRecord
+
+
+def test_record_signature_and_forgery():
+    sk = interop_secret_key(1)
+    rec = NodeRecord(
+        seq=1, pubkey=sk.to_public_key().to_bytes(), ip="127.0.0.1",
+        tcp_port=9000, udp_port=9001,
+    ).sign(sk)
+    assert rec.verify_signature()
+    decoded = NodeRecord.decode(rec.encode())
+    assert decoded.verify_signature()
+    assert decoded.node_id == rec.node_id
+    # forging another identity's record fails verification
+    forged = NodeRecord.decode(rec.encode())
+    forged.tcp_port = 6666  # tamper
+    assert not forged.verify_signature()
+    other = interop_secret_key(2)
+    stolen = NodeRecord(
+        seq=9, pubkey=other.to_public_key().to_bytes(), ip="10.0.0.1",
+        tcp_port=1, udp_port=1,
+    ).sign(sk)  # signed by the WRONG key
+    assert not stolen.verify_signature()
+
+
+def test_three_node_transitive_discovery():
+    async def main():
+        found = {"a": [], "b": [], "c": []}
+
+        svcs = {}
+        for name, idx in (("a", 1), ("b", 2), ("c", 3)):
+            svc = DiscoveryService(
+                interop_secret_key(idx), tcp_port=9000 + idx,
+                on_peer=lambda rec, _n=name: found[_n].append(rec),
+            )
+            await svc.listen(0)
+            svcs[name] = svc
+
+        # topology: A knows B; C knows B. A must learn C through B.
+        svcs["a"].add_bootstrap("127.0.0.1", svcs["b"].udp_port)
+        svcs["c"].add_bootstrap("127.0.0.1", svcs["b"].udp_port)
+        await asyncio.sleep(0.3)
+        # B now knows both; A asks B for nodes
+        svcs["a"].find_nodes()
+        for _ in range(50):
+            if len(svcs["a"].table) >= 2:
+                break
+            await asyncio.sleep(0.1)
+        ids_a = {rec.pubkey for rec in (e.record for e in svcs["a"].table.values())}
+        assert svcs["c"].record.pubkey in ids_a, "A never learned about C"
+        assert svcs["b"].record.pubkey in ids_a
+        assert any(r.pubkey == svcs["c"].record.pubkey for r in found["a"])
+
+        # subnet advertisement rides the record
+        svcs["c"].update_subnets([False] * 63 + [True], [True, False, False, False])
+        svcs["a"].find_nodes()
+        await asyncio.sleep(0.3)
+        c_entry = svcs["a"].table.get(svcs["c"].record.node_id)
+        # seq bumped -> updated record replaces the old one
+        assert c_entry is not None and c_entry.record.attnets[7] & 0x80
+
+        for svc in svcs.values():
+            await svc.close()
+
+    asyncio.run(main())
+
+
+def test_network_dials_discovered_peers():
+    async def main():
+        from lodestar_tpu.chain.bls_pool import BlsBatchPool
+        from lodestar_tpu.chain.handlers import GossipHandlers
+        from lodestar_tpu.config.chain_config import ChainConfig
+        from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+        from lodestar_tpu.network import Network
+        from lodestar_tpu.node.dev_chain import DevChain
+        from lodestar_tpu.params import MINIMAL
+
+        cfg = ChainConfig(
+            PRESET_BASE="minimal", SHARD_COMMITTEE_PERIOD=0, MIN_GENESIS_TIME=0,
+            MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
+            ALTAIR_FORK_EPOCH=2**64 - 1, BELLATRIX_FORK_EPOCH=2**64 - 1,
+        )
+        pools, nets = [], []
+        for i in range(2):
+            pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+            dev = DevChain(MINIMAL, cfg, 16, pool)
+            net = Network(MINIMAL, dev.chain, GossipHandlers(dev.chain))
+            await net.listen(0)
+            pools.append(pool)
+            nets.append(net)
+        # discovery: B bootstraps off A's udp endpoint; B should then DIAL
+        # A's tcp listener automatically
+        udp_a = await nets[0].enable_discovery(interop_secret_key(11))
+        await nets[1].enable_discovery(
+            interop_secret_key(12), bootstrap=[("127.0.0.1", udp_a)]
+        )
+        for _ in range(80):
+            if nets[1].peer_manager.peers and nets[0].peer_manager.peers:
+                break
+            await asyncio.sleep(0.1)
+        assert nets[1].peer_manager.peers, "B never dialed discovered peer A"
+        assert nets[0].peer_manager.peers, "A never saw B connect"
+        for net in nets:
+            await net.close()
+        for pool in pools:
+            pool.close()
+
+    asyncio.run(main())
