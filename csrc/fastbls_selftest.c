@@ -1,0 +1,63 @@
+/* Standalone driver for sanitizer runs (SURVEY section 5.2: the native
+ * host code must have an ASAN/UBSAN story — the reference has none to
+ * copy, its native code lives in deps).
+ *
+ * Build + run (tools/sanitize_native.sh):
+ *   cc -fsanitize=address,undefined -g fastbls_selftest.c -o t && ./t
+ * Exercises: pairing selftest, hash-to-G2, compressed-point parsing on
+ * hostile inputs, batch verify with a malformed set.
+ */
+
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+
+#include "fastbls.c"
+
+int main(void) {
+    if (!fb_selftest()) {
+        fprintf(stderr, "selftest FAILED\n");
+        return 1;
+    }
+    /* hash_to_g2 over varied message lengths (exercises expand_message) */
+    uint8_t out[192];
+    uint8_t msg[257];
+    for (int n = 0; n <= 256; n += 64) {
+        memset(msg, (uint8_t)n, (size_t)n);
+        if (fb_hash_to_g2(out, msg, (size_t)n) != FB_OK) {
+            fprintf(stderr, "hash_to_g2 FAILED at len %d\n", n);
+            return 1;
+        }
+    }
+    /* hostile compressed points: every flag pattern over garbage bytes */
+    uint8_t pt[96];
+    g1_t g1p_;
+    g2_t g2p_;
+    for (int flags = 0; flags < 256; flags++) {
+        memset(pt, 0xA5, sizeof pt);
+        pt[0] = (uint8_t)flags;
+        (void)g1_from_compressed(&g1p_, pt);
+        (void)g2_from_compressed(&g2p_, pt);
+    }
+    /* batch verify with malformed inputs must return FB_MALFORMED, not
+     * read out of bounds */
+    uint8_t pk[48], sig[96], m[32];
+    memset(pk, 0xFF, sizeof pk);
+    memset(sig, 0xFF, sizeof sig);
+    memset(m, 0, sizeof m);
+    uint32_t one = 1;
+    uint64_t coeff = 3;
+    if (fb_batch_verify(1, pk, &one, m, sig, &coeff) != FB_MALFORMED) {
+        fprintf(stderr, "malformed input not rejected\n");
+        return 1;
+    }
+    /* final-exp bytes out of range must be rejected */
+    uint8_t f_bytes[576];
+    memset(f_bytes, 0xFF, sizeof f_bytes);
+    if (fb_final_exp_is_one(f_bytes) != FB_MALFORMED) {
+        fprintf(stderr, "out-of-range fq12 not rejected\n");
+        return 1;
+    }
+    printf("sanitizer selftest OK\n");
+    return 0;
+}

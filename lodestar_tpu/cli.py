@@ -56,6 +56,8 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument("--altair-epoch", type=int, default=None)
         p.add_argument("--bellatrix-epoch", type=int, default=None)
         p.add_argument("--validators", type=int, default=16)
+        p.add_argument("--config", help="JSON rc file of persisted flag values "
+                       "(written by `init`; explicit CLI flags win)")
         p.add_argument(
             "--bls-verifier",
             choices=("auto", "tpu", "native", "python"),
@@ -95,6 +97,10 @@ def build_parser() -> argparse.ArgumentParser:
                     "(overrides --interop-indices; cmds/account import flow)")
     vc.add_argument("--keystores-password-file",
                     help="file holding the shared keystore password")
+
+    init_cmd = sub.add_parser("init", help="persist flag values to an rc file (cmds/init)")
+    common(init_cmd)
+    init_cmd.add_argument("--out", default="lodestar-tpu.rc.json")
 
     acct = sub.add_parser("account", help="keystore management (cmds/account)")
     acct_sub = acct.add_subparsers(dest="account_cmd", required=True)
@@ -456,8 +462,46 @@ def run_account(args) -> int:
     return 2
 
 
+def _apply_config_file(args, argv) -> None:
+    """Overlay persisted rc values (cmds/init persistence): an rc value
+    applies unless the same flag was given explicitly on the command
+    line."""
+    path = getattr(args, "config", None)
+    if not path:
+        return
+    with open(path) as f:
+        persisted = json.load(f)
+    explicit = set()
+    for tok in argv or sys.argv[1:]:
+        if tok.startswith("--"):
+            explicit.add(tok[2:].split("=", 1)[0].replace("-", "_"))
+    for key, value in persisted.items():
+        if key in ("cmd", "out", "config") or key in explicit:
+            continue
+        if hasattr(args, key):
+            setattr(args, key, value)
+
+
+def run_init(args) -> int:
+    """Write the resolved flag values to an rc file (cmds/init/handler.ts
+    persistOptionsAndConfig)."""
+    payload = {
+        k: v for k, v in vars(args).items()
+        if k not in ("cmd", "out", "config") and not callable(v)
+    }
+    with open(args.out, "w") as f:
+        json.dump(payload, f, indent=2, default=str)
+    print(f"wrote {args.out}")
+    return 0
+
+
 def main(argv: Optional[list] = None) -> int:
     args = build_parser().parse_args(argv)
+    try:
+        _apply_config_file(args, argv)
+    except (OSError, ValueError) as e:
+        print(f"bad --config file: {e}", file=sys.stderr)
+        return 2
     if args.cmd == "dev":
         return asyncio.run(run_dev(args))
     if args.cmd == "beacon":
@@ -468,6 +512,8 @@ def main(argv: Optional[list] = None) -> int:
         return asyncio.run(run_lightclient(args))
     if args.cmd == "account":
         return run_account(args)
+    if args.cmd == "init":
+        return run_init(args)
     return 2
 
 
