@@ -45,8 +45,8 @@ def build_batch(n: int):
 
 
 def bench_split_dispatch(args, repeats: int = 3):
-    """The production path: device Miller product + host C final exp,
-    timed end-to-end (device compute + 2.4KB transfer + host tail)."""
+    """The split path: device Miller product + host C final exp, timed
+    end-to-end (device compute + 2.4KB transfer + host tail)."""
     import jax
 
     from lodestar_tpu.crypto.bls.tpu_verifier import TpuBlsVerifier
@@ -64,6 +64,26 @@ def bench_split_dispatch(args, repeats: int = 3):
         verdict = v._host_final_exp_verdict(f, ok)
         times.append(time.perf_counter() - t0)
         assert verdict
+    dt = min(times)
+    n = args[0].shape[0]
+    return n / dt, dt
+
+
+def bench_fused_dispatch(args, repeats: int = 3):
+    """The single fused device program (final exp on device)."""
+    import jax
+
+    from lodestar_tpu.ops.batch_verify import verify_signature_sets_kernel
+
+    fn = jax.jit(verify_signature_sets_kernel)
+    out = fn(*args)
+    assert bool(out), "benchmark batch failed to verify"
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        assert bool(out)  # value read = hard sync
+        times.append(time.perf_counter() - t0)
     dt = min(times)
     n = args[0].shape[0]
     return n / dt, dt
@@ -271,7 +291,22 @@ def bench_dev_chain(time_budget_s: float = 150.0):
 
 def main() -> None:
     args = build_batch(BATCH)
-    dev_rate, dt = bench_split_dispatch(args)
+    # measure BOTH dispatch modes (XLA compile variance between the two
+    # programs is ±15-25%, see docs/round4.md); headline the faster one
+    split_rate, split_dt = bench_split_dispatch(args)
+    try:
+        fused_rate, fused_dt = bench_fused_dispatch(args)
+    except AssertionError:
+        # a fused kernel returning the WRONG verdict is a miscompile, not
+        # a benign fallback — surface it, don't headline the split number
+        raise
+    except Exception as e:
+        print(f"fused dispatch unavailable: {e!r}", file=sys.stderr)
+        fused_rate, fused_dt = None, None
+    if fused_rate is not None and fused_rate > split_rate:
+        dev_rate, dt, mode = fused_rate, fused_dt, "fused"
+    else:
+        dev_rate, dt, mode = split_rate, split_dt, "split+host-final-exp"
     cpu_native = bench_cpu_native()
     cpu_oracle = bench_cpu_oracle()
     small_dt = bench_small_bucket()
@@ -293,6 +328,9 @@ def main() -> None:
                 "extras": {
                     "batch": BATCH,
                     "dispatch_ms": round(dt * 1e3, 2),
+                    "dispatch_mode": mode,
+                    "dispatch_ms_split": round(split_dt * 1e3, 2),
+                    "dispatch_ms_fused": round(fused_dt * 1e3, 2) if fused_dt else None,
                     "dispatch_ms_bucket16": round(small_dt * 1e3, 2) if small_dt else None,
                     "cpu_native_sets_per_s": round(cpu_native, 1) if cpu_native else None,
                     "cpu_oracle_sets_per_s": round(cpu_oracle, 3),

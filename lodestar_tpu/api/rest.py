@@ -199,9 +199,18 @@ class RestApiServer:
         r("POST", "/eth/v1/validator/duties/attester/{epoch}", self._attester_duties)
         r("GET", "/eth/v2/validator/blocks/{slot}", self._produce_block)
         r("POST", "/eth/v1/beacon/blocks", self._publish_block)
+        # builder flow (routes/validator.ts produceBlindedBlock,
+        # registerValidator, prepareBeaconProposer; routes/beacon/block.ts
+        # publishBlindedBlock)
+        r("GET", "/eth/v1/validator/blinded_blocks/{slot}", self._produce_blinded_block)
+        r("POST", "/eth/v1/beacon/blinded_blocks", self._publish_blinded_block)
+        r("POST", "/eth/v1/validator/prepare_beacon_proposer", self._prepare_proposer)
+        r("POST", "/eth/v1/validator/register_validator", self._register_validator)
         r("GET", "/eth/v1/validator/attestation_data", self._attestation_data)
         r("POST", "/eth/v1/beacon/pool/attestations", self._submit_attestations)
         r("POST", "/eth/v1/beacon/pool/voluntary_exits", self._submit_exit)
+        r("POST", "/eth/v1/beacon/pool/proposer_slashings", self._submit_proposer_slashing)
+        r("POST", "/eth/v1/beacon/pool/attester_slashings", self._submit_attester_slashing)
         r("GET", "/eth/v1/validator/aggregate_attestation", self._aggregate_attestation)
         r("POST", "/eth/v1/validator/aggregate_and_proofs", self._submit_aggregates)
         r("POST", "/eth/v1/validator/liveness/{epoch}", self._liveness)
@@ -533,6 +542,56 @@ class RestApiServer:
             await self.network.publish_block(signed)
         return {"data": {"root": "0x" + root.hex()}}
 
+    async def _produce_blinded_block(self, pp, q, b):
+        slot = int(pp["slot"])
+        randao = bytes.fromhex(q.get("randao_reveal", "0x" + "00" * 96)[2:])
+        try:
+            block, _proposer = await self.chain.produce_blinded_block(slot, randao)
+        except Exception as e:  # builder down/missing -> 503 per spec
+            raise ApiError(503, f"blinded production unavailable: {e}")
+        from ..state_transition.upgrade import block_fork_name
+
+        return {"version": block_fork_name(block).value, "data": to_json(block)}
+
+    async def _publish_blinded_block(self, pp, q, b):
+        signed_blinded = from_json(b)
+        root = await self.chain.publish_blinded_block(signed_blinded)
+        # broadcast the UNBLINDED block (the import path persisted it):
+        # peers must receive the full payload, same as _publish_block
+        if self.network is not None:
+            signed = self.chain.db.block.get(root)
+            if signed is not None:
+                await self.network.publish_block(signed)
+        return {"data": {"root": "0x" + root.hex()}}
+
+    def _prepare_proposer(self, pp, q, b):
+        """prepareBeaconProposer: remember each validator's fee recipient
+        (chain/beaconProposerCache.ts)."""
+        from ..state_transition import compute_epoch_at_slot as _epoch_at
+
+        epoch = 0
+        if self.chain.clock is not None:
+            epoch = _epoch_at(self.p, self.chain.clock.current_slot)
+        cache = self.chain.beacon_proposer_cache
+        for entry in b or []:
+            cache.add(
+                epoch,
+                int(entry["validator_index"]),
+                bytes.fromhex(entry["fee_recipient"][2:]),
+            )
+        cache.prune(epoch)
+        return {}
+
+    async def _register_validator(self, pp, q, b):
+        """registerValidator: forward signed registrations to the builder
+        (api/impl/validator registerValidator)."""
+        regs = [from_json(r) for r in b or []]
+        builder = getattr(self.chain, "builder", None)
+        if builder is None:
+            return {}
+        await self.chain._maybe_await(builder.register_validator(regs))
+        return {}
+
     def _attestation_data(self, pp, q, b):
         slot = int(q["slot"])
         index = int(q.get("committee_index", 0))
@@ -579,6 +638,16 @@ class RestApiServer:
         self.chain.op_pool.add_voluntary_exit(signed_exit)
         if self.network is not None:
             await self.network.publish_voluntary_exit(signed_exit)
+        return {}
+
+    def _submit_proposer_slashing(self, pp, q, b):
+        """routes/beacon/pool.ts submitPoolProposerSlashings (the flare
+        self-slash target)."""
+        self.chain.op_pool.add_proposer_slashing(from_json(b))
+        return {}
+
+    def _submit_attester_slashing(self, pp, q, b):
+        self.chain.op_pool.add_attester_slashing(from_json(b))
         return {}
 
     def _aggregate_attestation(self, pp, q, b):

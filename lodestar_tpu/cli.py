@@ -23,6 +23,22 @@ from .utils.logger import get_logger
 logger = get_logger("cli")
 
 
+def _hex_bytes(value: str, length: int, flag: str) -> bytes:
+    """Parse a CLI hex argument (0x optional) and FAIL at config time on a
+    wrong length — a silent [2:] slice of an unprefixed value would drop
+    its first byte and mis-route funds long after startup."""
+    raw = value[2:] if value.startswith("0x") else value
+    try:
+        out = bytes.fromhex(raw)
+    except ValueError:
+        raise SystemExit(f"{flag}: not valid hex: {value!r}")
+    if len(out) != length:
+        raise SystemExit(
+            f"{flag}: expected {length} bytes ({length * 2} hex chars), got {len(out)}"
+        )
+    return out
+
+
 def _preset(name: str) -> Preset:
     return {"mainnet": MAINNET, "minimal": MINIMAL}[name]
 
@@ -85,6 +101,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="trusted beacon REST URL to fetch the finalized state from "
         "(initBeaconState.ts:104-136); backfill then earns history backwards",
     )
+    beacon.add_argument("--execution-url",
+                        help="Engine API JSON-RPC endpoint (execution/engine/http.ts)")
+    beacon.add_argument("--jwt-secret",
+                        help="file holding the hex-encoded engine jwt secret")
+    beacon.add_argument("--builder-url",
+                        help="MEV builder REST endpoint (execution/builder/http.ts)")
+    beacon.add_argument("--builder-pubkey",
+                        help="hex BLS pubkey pinning the builder identity; "
+                        "bids signed by any other key are refused")
+    beacon.add_argument("--suggested-fee-recipient", default="0x" + "00" * 20,
+                        help="node-default fee recipient when a proposer sent "
+                        "no preparation")
 
     vc = sub.add_parser("validator", help="validator client (cmds/validator)")
     vc.add_argument("--beacon-url", default="http://127.0.0.1:9596")
@@ -97,6 +125,15 @@ def build_parser() -> argparse.ArgumentParser:
                     "(overrides --interop-indices; cmds/account import flow)")
     vc.add_argument("--keystores-password-file",
                     help="file holding the shared keystore password")
+    vc.add_argument("--remote-signer-url",
+                    help="web3signer-compatible remote signer URL "
+                    "(validatorStore.ts SignerType.Remote)")
+    vc.add_argument("--fee-recipient", default="0x" + "00" * 20,
+                    help="suggested fee recipient, sent via "
+                    "prepareBeaconProposer each epoch")
+    vc.add_argument("--gas-limit", type=int, default=30_000_000)
+    vc.add_argument("--builder", action="store_true",
+                    help="prefer blinded (MEV builder) block production")
 
     init_cmd = sub.add_parser("init", help="persist flag values to an rc file (cmds/init)")
     common(init_cmd)
@@ -241,7 +278,40 @@ async def run_beacon(args) -> int:
         resumed = db.last_archived_state()
         genesis = resumed or interop_genesis_state(preset, cfg, args.validators, 1)
     pool = BlsBatchPool(_make_verifier(args))
-    chain = BeaconChain(preset, cfg, genesis, pool, db=db)
+    execution_engine = None
+    if args.execution_url:
+        from urllib.parse import urlparse as _urlparse
+
+        from .execution.engine import ExecutionEngineHttp, jwt_supplier_from_secret
+
+        jwt_supplier = None
+        if args.jwt_secret:
+            jwt_supplier = jwt_supplier_from_secret(
+                bytes.fromhex(open(args.jwt_secret).read().strip().replace("0x", ""))
+            )
+        eu = _urlparse(args.execution_url)
+        execution_engine = ExecutionEngineHttp(
+            eu.hostname or "127.0.0.1", eu.port or 8551, jwt_supplier=jwt_supplier
+        )
+    builder = None
+    if args.builder_url:
+        from urllib.parse import urlparse as _urlparse
+
+        from .execution.builder import ExecutionBuilderHttp
+
+        bu = _urlparse(args.builder_url)
+        builder = ExecutionBuilderHttp(
+            bu.hostname or "127.0.0.1", bu.port or 18550,
+            pubkey=_hex_bytes(args.builder_pubkey, 48, "--builder-pubkey")
+            if args.builder_pubkey else None,
+        )
+    chain = BeaconChain(
+        preset, cfg, genesis, pool, db=db,
+        execution_engine=execution_engine, builder=builder,
+        default_fee_recipient=_hex_bytes(
+            args.suggested_fee_recipient, 20, "--suggested-fee-recipient"
+        ),
+    )
     handlers = GossipHandlers(chain)
     network = Network(preset, chain, handlers)
     await network.listen(args.listen_port)
@@ -350,8 +420,32 @@ async def run_validator(args) -> int:
     protection = SlashingProtection(persist_path=args.slashing_protection_db)
     genesis = await api.get("/eth/v1/beacon/genesis")
     gvr = bytes.fromhex(genesis["data"]["genesis_validators_root"][2:])
-    store = ValidatorStore(preset, cfg, keys, protection, genesis_validators_root=gvr)
-    vc = ValidatorClient(preset, cfg, store, api)
+    # remote signer (validatorStore.ts SignerType.Remote): pull the key
+    # list from the signer and resolve indices over the beacon API
+    remote_signer = None
+    remote_keys = {}
+    if getattr(args, "remote_signer_url", None):
+        from .validator.remote_signer import RemoteSignerClient
+
+        remote_signer = RemoteSignerClient(args.remote_signer_url)
+        for pk in remote_signer.public_keys():
+            try:
+                info = await api.get(
+                    f"/eth/v1/beacon/states/head/validators/0x{pk.hex()}"
+                )
+                remote_keys[int(info["data"]["index"])] = pk
+            except Exception:
+                logger.warning("remote key 0x%s... not yet active", pk.hex()[:12])
+        logger.info("remote signer: %d keys from %s", len(remote_keys), args.remote_signer_url)
+    store = ValidatorStore(preset, cfg, keys, protection, genesis_validators_root=gvr,
+                           remote_signer=remote_signer, remote_keys=remote_keys)
+    fee_recipient = _hex_bytes(
+        getattr(args, "fee_recipient", "0x" + "00" * 20), 20, "--fee-recipient"
+    )
+    vc = ValidatorClient(preset, cfg, store, api,
+                         fee_recipient=fee_recipient,
+                         gas_limit=getattr(args, "gas_limit", 30_000_000),
+                         builder_enabled=getattr(args, "builder", False))
     from .validator import ChainHeaderTracker
 
     tracker = ChainHeaderTracker(api)

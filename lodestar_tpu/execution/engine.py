@@ -32,6 +32,27 @@ class ExecutePayloadStatus(str, enum.Enum):
     ACCEPTED = "ACCEPTED"
 
 
+def jwt_supplier_from_secret(secret: bytes):
+    """Engine-API jwt auth (reference eth1/provider/jwt.ts encodeJwtToken):
+    HS256 over {"iat": now}, re-minted per request so the EL's 60s iat
+    window never expires a cached token."""
+    import base64
+    import hmac
+
+    def _b64url(data: bytes) -> bytes:
+        return base64.urlsafe_b64encode(data).rstrip(b"=")
+
+    header = _b64url(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+
+    def supply() -> str:
+        payload = _b64url(json.dumps({"iat": int(time.time())}).encode())
+        signing_input = header + b"." + payload
+        sig = _b64url(hmac.new(secret, signing_input, "sha256").digest())
+        return (signing_input + b"." + sig).decode()
+
+    return supply
+
+
 class ExecutionEngineMock:
     """In-process engine double (mock.ts:23): remembers payloads it built
     or validated; everything chains off `genesis_block_hash`."""

@@ -82,7 +82,13 @@ class DevChain:
 
         epoch = compute_epoch_at_slot(self.p, block.slot)
         domain = get_domain(self.p, state, DOMAIN_BEACON_PROPOSER, epoch)
-        root = compute_signing_root(self.p, block_types(self.p, block).BeaconBlock, block, domain)
+        t = block_types(self.p, block)
+        block_type = (
+            t.BlindedBeaconBlock
+            if "execution_payload_header" in block.body
+            else t.BeaconBlock
+        )
+        root = compute_signing_root(self.p, block_type, block, domain)
         return self.keys[proposer].sign(root).to_bytes()
 
     def _sign_sync_aggregate(self, pre):

@@ -77,10 +77,20 @@ def _is_default_payload(p: Preset, payload) -> bool:
     return t.ExecutionPayload.serialize(payload) == t.ExecutionPayload.serialize(default)
 
 
-def is_merge_transition_block(p: Preset, state, body) -> bool:
-    return not is_merge_transition_complete(p, state) and not _is_default_payload(
-        p, body.execution_payload
+def _is_default_payload_header(p: Preset, header) -> bool:
+    t = get_types(p).bellatrix
+    return t.ExecutionPayloadHeader.serialize(header) == t.ExecutionPayloadHeader.serialize(
+        default_payload_header(p)
     )
+
+
+def is_merge_transition_block(p: Preset, state, body) -> bool:
+    if is_merge_transition_complete(p, state):
+        return False
+    if "execution_payload_header" in body:
+        # blinded body (spec blinded-beacon-block variant): judge by header
+        return not _is_default_payload_header(p, body.execution_payload_header)
+    return not _is_default_payload(p, body.execution_payload)
 
 
 def is_execution_enabled(p: Preset, state, body) -> bool:
@@ -99,9 +109,17 @@ def process_execution_payload(
     body,
     execution_engine: Optional[ExecutionEngine] = None,
 ) -> None:
-    """Spec process_execution_payload (block/processExecutionPayload.ts)."""
+    """Spec process_execution_payload (block/processExecutionPayload.ts).
+
+    Accepts either a full body (``execution_payload``) or a blinded one
+    (``execution_payload_header``): the builder flow signs over the
+    header alone, so the header-only transition must produce the exact
+    state root the full-payload transition would (the installed header
+    is identical either way).  Reference: the `blinded` type param
+    threading through processExecutionPayload.ts."""
     t = get_types(p).bellatrix
-    payload = body.execution_payload
+    blinded = "execution_payload_header" in body
+    payload = body.execution_payload_header if blinded else body.execution_payload
     if is_merge_transition_complete(p, state):
         if bytes(payload.parent_hash) != bytes(state.latest_execution_payload_header.block_hash):
             raise BlockProcessingError("execution payload parent hash mismatch")
@@ -110,10 +128,14 @@ def process_execution_payload(
         raise BlockProcessingError("execution payload prev_randao mismatch")
     if payload.timestamp != compute_timestamp_at_slot(p, cfg, state, state.slot):
         raise BlockProcessingError("execution payload timestamp mismatch")
-    if execution_engine is not None and not execution_engine.notify_new_payload(payload):
+    if not blinded and execution_engine is not None and not execution_engine.notify_new_payload(payload):
         raise BlockProcessingError("execution payload rejected by engine")
 
-    tx_list_type = dict(t.ExecutionPayload.fields)["transactions"]
+    if blinded:
+        transactions_root = bytes(payload.transactions_root)
+    else:
+        tx_list_type = dict(t.ExecutionPayload.fields)["transactions"]
+        transactions_root = tx_list_type.hash_tree_root(payload.transactions)
     state.latest_execution_payload_header = Fields(
         parent_hash=bytes(payload.parent_hash),
         fee_recipient=bytes(payload.fee_recipient),
@@ -128,5 +150,5 @@ def process_execution_payload(
         extra_data=bytes(payload.extra_data),
         base_fee_per_gas=payload.base_fee_per_gas,
         block_hash=bytes(payload.block_hash),
-        transactions_root=tx_list_type.hash_tree_root(payload.transactions),
+        transactions_root=transactions_root,
     )

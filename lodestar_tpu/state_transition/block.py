@@ -78,12 +78,20 @@ def process_block_header(p: Preset, ctx: EpochContext, state, block) -> None:
         raise BlockProcessingError("wrong proposer index")
     if block.parent_root != t.BeaconBlockHeader.hash_tree_root(state.latest_block_header):
         raise BlockProcessingError("parent root mismatch")
+    # a blinded body merkleizes to the SAME root as its full counterpart
+    # (transactions_root == htr(transactions) by construction) but needs
+    # its own container type to compute it
+    body_type = (
+        t.BlindedBeaconBlockBody
+        if "execution_payload_header" in block.body
+        else t.BeaconBlockBody
+    )
     state.latest_block_header = Fields(
         slot=block.slot,
         proposer_index=block.proposer_index,
         parent_root=block.parent_root,
         state_root=b"\x00" * 32,  # set on the next process_slot
-        body_root=t.BeaconBlockBody.hash_tree_root(block.body),
+        body_root=body_type.hash_tree_root(block.body),
     )
     if state.validators[block.proposer_index].slashed:
         raise BlockProcessingError("proposer is slashed")

@@ -36,7 +36,9 @@ def state_fork_name(state) -> ForkName:
 def block_fork_name(block) -> ForkName:
     """Structural fork detection for a BeaconBlock value (by body fields)."""
     body = block.body
-    if hasattr(body, "execution_payload"):
+    if hasattr(body, "execution_payload") or hasattr(body, "execution_payload_header"):
+        # blinded bodies (builder flow) carry only the payload header but
+        # are the same fork as their full counterpart
         return ForkName.bellatrix
     if hasattr(body, "sync_aggregate"):
         return ForkName.altair

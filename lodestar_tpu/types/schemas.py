@@ -469,6 +469,63 @@ def _bellatrix(p: Preset, al: ForkTypes, ph: ForkTypes) -> ForkTypes:
     t.SignedBeaconBlock = Container(
         "SignedBeaconBlock", [("message", t.BeaconBlock), ("signature", BLSSignature)]
     )
+    # blinded blocks + builder flow (packages/types/src/bellatrix/sszTypes.ts
+    # BlindedBeaconBlockBody / BuilderBid / ValidatorRegistrationV1): the body
+    # carries only the payload HEADER; the full payload stays with the builder
+    # until the signed blinded block is revealed.
+    t.BlindedBeaconBlockBody = Container(
+        "BlindedBeaconBlockBody",
+        [
+            ("randao_reveal", BLSSignature),
+            ("eth1_data", ph.Eth1Data),
+            ("graffiti", Bytes32),
+            ("proposer_slashings", List(ph.ProposerSlashing, p.MAX_PROPOSER_SLASHINGS)),
+            ("attester_slashings", List(ph.AttesterSlashing, p.MAX_ATTESTER_SLASHINGS)),
+            ("attestations", List(ph.Attestation, p.MAX_ATTESTATIONS)),
+            ("deposits", List(ph.Deposit, p.MAX_DEPOSITS)),
+            ("voluntary_exits", List(ph.SignedVoluntaryExit, p.MAX_VOLUNTARY_EXITS)),
+            ("sync_aggregate", al.SyncAggregate),
+            ("execution_payload_header", t.ExecutionPayloadHeader),
+        ],
+    )
+    t.BlindedBeaconBlock = Container(
+        "BlindedBeaconBlock",
+        [
+            ("slot", Slot),
+            ("proposer_index", ValidatorIndex),
+            ("parent_root", Root),
+            ("state_root", Root),
+            ("body", t.BlindedBeaconBlockBody),
+        ],
+    )
+    t.SignedBlindedBeaconBlock = Container(
+        "SignedBlindedBeaconBlock",
+        [("message", t.BlindedBeaconBlock), ("signature", BLSSignature)],
+    )
+    t.ValidatorRegistrationV1 = Container(
+        "ValidatorRegistrationV1",
+        [
+            ("fee_recipient", Bytes20),
+            ("gas_limit", uint64),
+            ("timestamp", uint64),
+            ("pubkey", BLSPubkey),
+        ],
+    )
+    t.SignedValidatorRegistration = Container(
+        "SignedValidatorRegistration",
+        [("message", t.ValidatorRegistrationV1), ("signature", BLSSignature)],
+    )
+    t.BuilderBid = Container(
+        "BuilderBid",
+        [
+            ("header", t.ExecutionPayloadHeader),
+            ("value", uint256),
+            ("pubkey", BLSPubkey),
+        ],
+    )
+    t.SignedBuilderBid = Container(
+        "SignedBuilderBid", [("message", t.BuilderBid), ("signature", BLSSignature)]
+    )
     t.BeaconState = Container(
         "BeaconState",
         [

@@ -25,11 +25,23 @@ logger = get_logger("validator")
 
 class ValidatorClient:
     def __init__(self, preset: Preset, cfg: ChainConfig, store: ValidatorStore, api: ApiClient,
-                 doppelganger_epochs: int = 0):
+                 doppelganger_epochs: int = 0,
+                 fee_recipient: bytes = b"\x00" * 20,
+                 gas_limit: int = 30_000_000,
+                 builder_enabled: bool = False):
         self.p = preset
         self.cfg = cfg
         self.store = store
         self.api = api
+        # proposer preparation + builder registration config
+        # (services/prepareBeaconProposer.ts, --suggestedFeeRecipient flag)
+        self.fee_recipient = fee_recipient
+        self.gas_limit = gas_limit
+        self.builder_enabled = builder_enabled
+        # per-pubkey overrides, written by the keymanager
+        # feerecipient/gas_limit routes (keymanager routes.ts)
+        self.fee_recipient_overrides: Dict[bytes, bytes] = {}
+        self.gas_limit_overrides: Dict[bytes, int] = {}
         self._attester_duties: Dict[int, List[dict]] = {}  # epoch -> duties
         self._proposer_duties: Dict[int, List[dict]] = {}
         # doppelganger protection (validator.ts + services/doppelgangerService):
@@ -42,6 +54,12 @@ class ValidatorClient:
         # when present, attestations trigger on the head SSE event
         self.header_tracker = None
         self.attested_on_event = 0
+
+    def _fee_recipient_for(self, pubkey: bytes) -> bytes:
+        return self.fee_recipient_overrides.get(bytes(pubkey), self.fee_recipient)
+
+    def _gas_limit_for(self, pubkey: bytes) -> int:
+        return self.gas_limit_overrides.get(bytes(pubkey), self.gas_limit)
 
     class DoppelgangerDetected(Exception):
         pass
@@ -62,7 +80,7 @@ class ValidatorClient:
             self._doppelganger_window = set(
                 range(max(0, current_epoch - 1), current_epoch + self.doppelganger_epochs)
             )
-        indices = [str(i) for i in self.store.keys]
+        indices = [str(i) for i in self.store.pubkeys]
         for epoch in sorted(self._doppelganger_window):
             if epoch >= current_epoch:
                 continue  # not complete yet — probe on a later call
@@ -83,11 +101,11 @@ class ValidatorClient:
     # -- duties (services/attestationDuties.ts / blockDuties.ts) --------------
 
     async def poll_duties(self, epoch: int) -> None:
-        indices = [str(i) for i in self.store.keys]
+        indices = [str(i) for i in self.store.pubkeys]
         att = await self.api.post(f"/eth/v1/validator/duties/attester/{epoch}", indices)
         self._attester_duties[epoch] = att["data"]
         prop = await self.api.get(f"/eth/v1/validator/duties/proposer/{epoch}")
-        ours = {str(i) for i in self.store.keys}
+        ours = {str(i) for i in self.store.pubkeys}
         self._proposer_duties[epoch] = [
             d for d in prop["data"] if d["validator_index"] in ours
         ]
@@ -110,6 +128,43 @@ class ValidatorClient:
                 )
             except Exception:  # noqa: BLE001 - advertisement is best-effort
                 pass
+        # re-send proposer preparations every epoch so the entries survive
+        # the node's PROPOSER_PRESERVE_EPOCHS pruning
+        # (services/prepareBeaconProposer.ts)
+        try:
+            await self.prepare_beacon_proposer()
+            if self.builder_enabled:
+                await self.register_validators()
+        except Exception:  # noqa: BLE001 - preparation is best-effort
+            pass
+
+    async def prepare_beacon_proposer(self) -> None:
+        entries = [
+            {
+                "validator_index": str(i),
+                "fee_recipient": "0x" + self._fee_recipient_for(pk).hex(),
+            }
+            for i, pk in self.store.pubkeys.items()
+        ]
+        if entries:
+            await self.api.post("/eth/v1/validator/prepare_beacon_proposer", entries)
+
+    async def register_validators(self, timestamp: Optional[int] = None) -> None:
+        """Sign + submit builder registrations for every managed validator
+        (services/validatorRegistration — DOMAIN_APPLICATION_BUILDER)."""
+        import time as _time
+
+        ts = int(timestamp if timestamp is not None else _time.time())
+        regs = [
+            to_json(
+                self.store.sign_validator_registration(
+                    i, self._fee_recipient_for(pk), self._gas_limit_for(pk), ts
+                )
+            )
+            for i, pk in self.store.pubkeys.items()
+        ]
+        if regs:
+            await self.api.post("/eth/v1/validator/register_validator", regs)
 
     # -- block production ------------------------------------------------------
 
@@ -124,13 +179,27 @@ class ValidatorClient:
             return None
         vi = int(duty["validator_index"])
         randao = self.store.sign_randao(vi, epoch)
-        resp = await self.api.get(
-            f"/eth/v2/validator/blocks/{slot}?randao_reveal=0x{randao.hex()}"
-        )
+        # builder path first when enabled (services/block.ts
+        # produceBlindedBlock preference), full production as fallback
+        blinded = False
+        resp = None
+        if self.builder_enabled:
+            try:
+                resp = await self.api.get(
+                    f"/eth/v1/validator/blinded_blocks/{slot}?randao_reveal=0x{randao.hex()}"
+                )
+                blinded = True
+            except Exception:  # noqa: BLE001 - builder down -> local block
+                resp = None
+        if resp is None:
+            resp = await self.api.get(
+                f"/eth/v2/validator/blocks/{slot}?randao_reveal=0x{randao.hex()}"
+            )
         block = from_json(resp["data"])
         sig = self.store.sign_block(vi, block)
+        publish_path = "/eth/v1/beacon/blinded_blocks" if blinded else "/eth/v1/beacon/blocks"
         out = await self.api.post(
-            "/eth/v1/beacon/blocks", to_json(Fields(message=block, signature=sig))
+            publish_path, to_json(Fields(message=block, signature=sig))
         )
         root = bytes.fromhex(out["data"]["root"][2:])
         logger.info("proposed block at slot %d: %s", slot, root.hex()[:12])
@@ -218,7 +287,7 @@ class ValidatorClient:
         signed ContributionAndProof."""
         from ..chain.sync_committee_pools import is_sync_committee_aggregator
 
-        indices = [str(i) for i in self.store.keys]
+        indices = [str(i) for i in self.store.pubkeys]
         epoch = compute_epoch_at_slot(self.p, slot)
         try:
             duties = (await self.api.post(f"/eth/v1/validator/duties/sync/{epoch}", indices))["data"]

@@ -28,11 +28,23 @@ logger = get_logger("keymanager")
 class KeymanagerApi:
     """Route logic, server-agnostic (testable without sockets)."""
 
-    def __init__(self, store, protection: SlashingProtection, index_resolver=None):
+    def __init__(self, store, protection: SlashingProtection, index_resolver=None,
+                 client=None):
         self.store = store  # ValidatorStore
         self.protection = protection
         # pubkey -> validator index; None = unknown (not yet activated)
         self.index_resolver = index_resolver or (lambda pk: None)
+        # ValidatorClient, for fee-recipient / gas-limit defaults
+        self.client = client
+        self._fee_recipients: Dict[bytes, bytes] = {}
+        self._gas_limits: Dict[bytes, int] = {}
+
+    def _placeholder_index(self) -> int:
+        """Synthetic negative index for a not-yet-activated key: strictly
+        below every existing index so deletes can never make two imports
+        collide (len-based schemes reuse freed slots)."""
+        indices = list(self.store.pubkeys) + list(self.store.keys)
+        return min([0] + indices) - 1
 
     def list_keystores(self) -> dict:
         data = [
@@ -68,13 +80,126 @@ class KeymanagerApi:
                     # keep the key under a synthetic negative index until
                     # it activates; signing paths resolve by index so an
                     # unknown validator simply has no duties yet
-                    idx = -(len(self.store.keys) + 1)
+                    idx = self._placeholder_index()
                 self.store.keys[idx] = sk
                 self.store.pubkeys[idx] = pk
                 statuses.append({"status": "imported", "message": ""})
             except (KeystoreError, ValueError, KeyError) as e:
                 statuses.append({"status": "error", "message": str(e)})
         return {"data": statuses}
+
+    # -- remotekeys namespace (keymanager routes.ts remote-key CRUD) -------
+
+    def list_remote_keys(self) -> dict:
+        store = self.store
+        local = set(store.keys)
+        data = [
+            {
+                "pubkey": "0x" + pk.hex(),
+                "url": getattr(store.remote_signer, "url", ""),
+                "readonly": False,
+            }
+            for i, pk in sorted(store.pubkeys.items())
+            if i not in local
+        ]
+        return {"data": data}
+
+    def import_remote_keys(self, body: dict) -> dict:
+        """POST /eth/v1/remotekeys: register pubkeys whose signatures come
+        from the remote signer.  Indices resolve like keystore imports."""
+        statuses = []
+        for entry in body.get("remote_keys", []):
+            try:
+                pk = bytes.fromhex(entry["pubkey"][2:])
+                if pk in self.store.pubkeys.values():
+                    statuses.append({"status": "duplicate", "message": ""})
+                    continue
+                if self.store.remote_signer is None:
+                    statuses.append(
+                        {"status": "error", "message": "no remote signer configured"}
+                    )
+                    continue
+                idx = self.index_resolver(pk)
+                if idx is None:
+                    idx = self._placeholder_index()
+                self.store.pubkeys[idx] = pk
+                statuses.append({"status": "imported", "message": ""})
+            except (ValueError, KeyError) as e:
+                statuses.append({"status": "error", "message": str(e)})
+        return {"data": statuses}
+
+    def delete_remote_keys(self, body: dict) -> dict:
+        statuses = []
+        for pk in body.get("pubkeys", []):
+            raw = bytes.fromhex(pk[2:])
+            idx = next(
+                (
+                    i
+                    for i, p in self.store.pubkeys.items()
+                    if p == raw and i not in self.store.keys
+                ),
+                None,
+            )
+            if idx is None:
+                statuses.append({"status": "not_found", "message": ""})
+                continue
+            del self.store.pubkeys[idx]
+            statuses.append({"status": "deleted", "message": ""})
+        return {"data": statuses}
+
+    # -- per-validator feerecipient / gas_limit (keymanager routes.ts) -----
+    # Single source of truth: the ValidatorClient's override maps (which
+    # the preparation/registration services read).  The private maps only
+    # exist for the client-less (standalone API) configuration.
+
+    def _fr_map(self):
+        return (
+            self.client.fee_recipient_overrides
+            if self.client is not None
+            else self._fee_recipients
+        )
+
+    def _gl_map(self):
+        return (
+            self.client.gas_limit_overrides
+            if self.client is not None
+            else self._gas_limits
+        )
+
+    def get_fee_recipient(self, pubkey_hex: str) -> dict:
+        fr = self._fr_map().get(bytes.fromhex(pubkey_hex[2:]))
+        if fr is None and self.client is not None:
+            fr = self.client.fee_recipient
+        return {
+            "data": {
+                "pubkey": pubkey_hex,
+                "ethaddress": "0x" + (fr or b"\x00" * 20).hex(),
+            }
+        }
+
+    def set_fee_recipient(self, pubkey_hex: str, body: dict) -> dict:
+        self._fr_map()[bytes.fromhex(pubkey_hex[2:])] = bytes.fromhex(
+            body["ethaddress"][2:]
+        )
+        return {}
+
+    def delete_fee_recipient(self, pubkey_hex: str) -> dict:
+        self._fr_map().pop(bytes.fromhex(pubkey_hex[2:]), None)
+        return {}
+
+    def get_gas_limit(self, pubkey_hex: str) -> dict:
+        gl = self._gl_map().get(bytes.fromhex(pubkey_hex[2:]))
+        if gl is None and self.client is not None:
+            gl = self.client.gas_limit
+        return {"data": {"pubkey": pubkey_hex, "gas_limit": str(gl or 30_000_000)}}
+
+    def set_gas_limit(self, pubkey_hex: str, body: dict) -> dict:
+        self._gl_map()[bytes.fromhex(pubkey_hex[2:])] = int(body["gas_limit"])
+        return {}
+
+    def delete_gas_limit(self, pubkey_hex: str) -> dict:
+        self._gl_map().pop(bytes.fromhex(pubkey_hex[2:]), None)
+        return {}
 
     def delete_keystores(self, body: dict) -> dict:
         wanted = {bytes.fromhex(pk[2:]) for pk in body.get("pubkeys", [])}
@@ -169,6 +294,29 @@ class KeymanagerServer:
                     return 200, self.api.import_keystores(parsed)
                 if method == "DELETE":
                     return 200, self.api.delete_keystores(parsed)
+            if path == "/eth/v1/remotekeys":
+                if method == "GET":
+                    return 200, self.api.list_remote_keys()
+                if method == "POST":
+                    return 200, self.api.import_remote_keys(parsed)
+                if method == "DELETE":
+                    return 200, self.api.delete_remote_keys(parsed)
+            m = re.fullmatch(r"/eth/v1/validator/(0x[0-9a-fA-F]{96})/feerecipient", path)
+            if m:
+                if method == "GET":
+                    return 200, self.api.get_fee_recipient(m.group(1))
+                if method == "POST":
+                    return 202, self.api.set_fee_recipient(m.group(1), parsed)
+                if method == "DELETE":
+                    return 204, self.api.delete_fee_recipient(m.group(1))
+            m = re.fullmatch(r"/eth/v1/validator/(0x[0-9a-fA-F]{96})/gas_limit", path)
+            if m:
+                if method == "GET":
+                    return 200, self.api.get_gas_limit(m.group(1))
+                if method == "POST":
+                    return 202, self.api.set_gas_limit(m.group(1), parsed)
+                if method == "DELETE":
+                    return 204, self.api.delete_gas_limit(m.group(1))
             return 404, {"code": 404, "message": f"no route {method} {path}"}
         except Exception as e:  # noqa: BLE001
             return 500, {"code": 500, "message": str(e)}

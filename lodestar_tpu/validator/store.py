@@ -39,6 +39,8 @@ class ValidatorStore:
         keys: Dict[int, SecretKey],
         slashing_protection: Optional[SlashingProtection] = None,
         genesis_validators_root: bytes = b"\x00" * 32,
+        remote_signer=None,
+        remote_keys: Optional[Dict[int, bytes]] = None,
     ):
         self.p = preset
         self.cfg = cfg
@@ -47,6 +49,20 @@ class ValidatorStore:
         self.gvr = genesis_validators_root
         self.protection = slashing_protection or SlashingProtection(genesis_validators_root)
         self.pubkeys = {i: sk.to_public_key().to_bytes() for i, sk in keys.items()}
+        # remote-signer validators (validatorStore.ts SignerType.Remote):
+        # we hold only the pubkey; every signing root goes over HTTP.
+        # Slashing protection still gates BEFORE the request leaves.
+        self.remote_signer = remote_signer
+        if remote_keys:
+            self.pubkeys.update(remote_keys)
+
+    def _sign(self, validator_index: int, root: bytes) -> bytes:
+        sk = self.keys.get(validator_index)
+        if sk is not None:
+            return sk.sign(root).to_bytes()
+        if self.remote_signer is None:
+            raise KeyError(f"no signer for validator {validator_index}")
+        return self.remote_signer.sign(self.pubkeys[validator_index], root)
 
     def _domain(self, domain_type: bytes, epoch: int) -> bytes:
         from ..config.fork_config import ForkConfig
@@ -59,19 +75,25 @@ class ValidatorStore:
     def sign_randao(self, validator_index: int, epoch: int) -> bytes:
         domain = self._domain(DOMAIN_RANDAO, epoch)
         root = compute_signing_root(self.p, uint64, epoch, domain)
-        return self.keys[validator_index].sign(root).to_bytes()
+        return self._sign(validator_index, root)
 
     def sign_block(self, validator_index: int, block) -> bytes:
         from ..state_transition.upgrade import block_types
 
         epoch = compute_epoch_at_slot(self.p, block.slot)
         domain = self._domain(DOMAIN_BEACON_PROPOSER, epoch)
-        root = compute_signing_root(
-            self.p, block_types(self.p, block).BeaconBlock, block, domain
+        t = block_types(self.p, block)
+        # a blinded block signs to the SAME root as its full counterpart,
+        # but needs its own container type to compute it
+        block_type = (
+            t.BlindedBeaconBlock
+            if "execution_payload_header" in block.body
+            else t.BeaconBlock
         )
+        root = compute_signing_root(self.p, block_type, block, domain)
         pk = self.pubkeys[validator_index]
         self.protection.check_and_insert_block_proposal(pk, block.slot, root)
-        return self.keys[validator_index].sign(root).to_bytes()
+        return self._sign(validator_index, root)
 
     def sign_attestation(self, validator_index: int, data) -> bytes:
         domain = self._domain(DOMAIN_BEACON_ATTESTER, data.target.epoch)
@@ -80,13 +102,13 @@ class ValidatorStore:
         self.protection.check_and_insert_attestation(
             pk, data.source.epoch, data.target.epoch, root
         )
-        return self.keys[validator_index].sign(root).to_bytes()
+        return self._sign(validator_index, root)
 
     def sign_selection_proof(self, validator_index: int, slot: int) -> bytes:
         epoch = compute_epoch_at_slot(self.p, slot)
         domain = self._domain(DOMAIN_SELECTION_PROOF, epoch)
         root = compute_signing_root(self.p, uint64, slot, domain)
-        return self.keys[validator_index].sign(root).to_bytes()
+        return self._sign(validator_index, root)
 
     def sign_aggregate_and_proof(self, validator_index: int, aggregate_and_proof) -> bytes:
         epoch = compute_epoch_at_slot(self.p, aggregate_and_proof.aggregate.data.slot)
@@ -94,7 +116,7 @@ class ValidatorStore:
         root = compute_signing_root(
             self.p, self.t.AggregateAndProof, aggregate_and_proof, domain
         )
-        return self.keys[validator_index].sign(root).to_bytes()
+        return self._sign(validator_index, root)
 
     def sign_sync_committee_message(
         self, validator_index: int, slot: int, beacon_block_root: bytes
@@ -111,7 +133,7 @@ class ValidatorStore:
             slot=slot,
             beacon_block_root=beacon_block_root,
             validator_index=validator_index,
-            signature=self.keys[validator_index].sign(root).to_bytes(),
+            signature=self._sign(validator_index, root),
         )
 
     def sign_sync_selection_proof(
@@ -125,7 +147,7 @@ class ValidatorStore:
         t_alt = _gt(self.p).altair
         data = Fields(slot=slot, subcommittee_index=subcommittee_index)
         root = compute_signing_root(self.p, t_alt.SyncAggregatorSelectionData, data, domain)
-        return self.keys[validator_index].sign(root).to_bytes()
+        return self._sign(validator_index, root)
 
     def sign_contribution_and_proof(self, validator_index: int, message) -> bytes:
         from ..params import DOMAIN_CONTRIBUTION_AND_PROOF
@@ -135,12 +157,38 @@ class ValidatorStore:
         domain = self._domain(DOMAIN_CONTRIBUTION_AND_PROOF, epoch)
         t_alt = _gt(self.p).altair
         root = compute_signing_root(self.p, t_alt.ContributionAndProof, message, domain)
-        return self.keys[validator_index].sign(root).to_bytes()
+        return self._sign(validator_index, root)
 
     def sign_voluntary_exit(self, validator_index: int, exit_epoch: int) -> Fields:
         msg = Fields(epoch=exit_epoch, validator_index=validator_index)
         domain = self._domain(DOMAIN_VOLUNTARY_EXIT, exit_epoch)
         root = compute_signing_root(self.p, self.t.VoluntaryExit, msg, domain)
         return Fields(
-            message=msg, signature=self.keys[validator_index].sign(root).to_bytes()
+            message=msg, signature=self._sign(validator_index, root)
         )
+
+    def sign_validator_registration(
+        self, validator_index: int, fee_recipient: bytes, gas_limit: int, timestamp: int
+    ) -> Fields:
+        """SignedValidatorRegistration for the MEV builder
+        (validatorStore.ts signValidatorRegistration).  The builder domain
+        binds the GENESIS fork version over a zero genesis_validators_root
+        — registrations are valid across the fork schedule."""
+        from ..params import DOMAIN_APPLICATION_BUILDER
+        from ..types import get_types as _gt
+
+        t_be = _gt(self.p).bellatrix
+        msg = Fields(
+            fee_recipient=bytes(fee_recipient),
+            gas_limit=int(gas_limit),
+            timestamp=int(timestamp),
+            pubkey=self.pubkeys[validator_index],
+        )
+        domain = compute_domain(
+            self.p,
+            DOMAIN_APPLICATION_BUILDER,
+            self.cfg.GENESIS_FORK_VERSION,
+            b"\x00" * 32,
+        )
+        root = compute_signing_root(self.p, t_be.ValidatorRegistrationV1, msg, domain)
+        return Fields(message=msg, signature=self._sign(validator_index, root))

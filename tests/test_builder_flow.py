@@ -1,0 +1,200 @@
+"""MEV builder flow: registrations, blinded production, unblind + import.
+
+Reference: packages/beacon-node/src/execution/builder/http.ts
+(registerValidator / getHeader / submitBlindedBlock),
+api/impl/validator produceBlindedBlock, chain/beaconProposerCache.ts.
+"""
+
+import asyncio
+
+import pytest
+
+from lodestar_tpu.chain.beacon_chain import BeaconChain, BlockError
+from lodestar_tpu.chain.beacon_proposer_cache import BeaconProposerCache
+from lodestar_tpu.chain.bls_pool import BlsBatchPool
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.execution.builder import (
+    ExecutionBuilderMock,
+    blind_body,
+    payload_to_header,
+    unblind_block,
+)
+from lodestar_tpu.execution.engine import ExecutionEngineMock
+from lodestar_tpu.node.dev_chain import DevChain
+from lodestar_tpu.params import MINIMAL
+from lodestar_tpu.ssz import Fields
+from lodestar_tpu.types import get_types
+
+
+def _cfg() -> ChainConfig:
+    return ChainConfig(
+        PRESET_BASE="minimal",
+        MIN_GENESIS_TIME=0,
+        SHARD_COMMITTEE_PERIOD=0,
+        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
+        ALTAIR_FORK_EPOCH=1,
+        BELLATRIX_FORK_EPOCH=2,
+    )
+
+
+def _dev_with_builder():
+    engine = ExecutionEngineMock(MINIMAL, genesis_block_hash=b"\x11" * 32)
+    cfg = _cfg()
+    pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.001)
+    dev = DevChain(MINIMAL, cfg, 16, pool, execution_engine=engine)
+    builder = ExecutionBuilderMock(
+        MINIMAL, engine, fork_version=cfg.GENESIS_FORK_VERSION
+    )
+    dev.chain.builder = builder
+    return dev, engine, builder
+
+
+# -- proposer cache --------------------------------------------------------
+
+
+def test_proposer_cache_add_prune_get():
+    cache = BeaconProposerCache(default_fee_recipient=b"\xaa" * 20)
+    cache.add(5, 7, b"\xbb" * 20)
+    assert cache.get(7) == b"\xbb" * 20
+    assert cache.get(8) == b"\xaa" * 20  # default for unknown
+    cache.prune(6)  # within PROPOSER_PRESERVE_EPOCHS
+    assert cache.get(7) == b"\xbb" * 20
+    cache.prune(9)  # expired
+    assert cache.get(7) == b"\xaa" * 20
+
+
+# -- blind / unblind round-trip --------------------------------------------
+
+
+def test_blinded_block_roots_match_full():
+    """The defining property of the builder flow: blinded and full bodies
+    merkleize to the same root, so one proposer signature covers both."""
+    t = get_types(MINIMAL).bellatrix
+    engine = ExecutionEngineMock(MINIMAL, genesis_block_hash=b"\x22" * 32)
+    pid = engine.notify_forkchoice_update(
+        b"\x22" * 32, b"\x22" * 32, b"\x22" * 32,
+        Fields(timestamp=12, prev_randao=b"\x03" * 32, suggested_fee_recipient=b"\x04" * 20),
+    )
+    payload = engine.get_payload(pid)
+    body = t.BeaconBlockBody.default()
+    body.execution_payload = payload
+    blinded = blind_body(MINIMAL, body)
+    assert bytes(t.BeaconBlockBody.hash_tree_root(body)) == bytes(
+        t.BlindedBeaconBlockBody.hash_tree_root(blinded)
+    )
+    # unblind restores the identical full body
+    signed_blinded = Fields(
+        message=Fields(
+            slot=1, proposer_index=0, parent_root=b"\x00" * 32,
+            state_root=b"\x00" * 32, body=blinded,
+        ),
+        signature=b"\x00" * 96,
+    )
+    signed = unblind_block(MINIMAL, signed_blinded, payload)
+    assert bytes(t.BeaconBlockBody.hash_tree_root(signed.message.body)) == bytes(
+        t.BeaconBlockBody.hash_tree_root(body)
+    )
+    # a tampered payload is refused
+    wrong = Fields(**{k: payload[k] for k in payload.keys()})
+    wrong.block_number = payload.block_number + 1
+    with pytest.raises(ValueError, match="does not match"):
+        unblind_block(MINIMAL, signed_blinded, wrong)
+
+
+def test_builder_mock_requires_registration():
+    engine = ExecutionEngineMock(MINIMAL)
+    builder = ExecutionBuilderMock(MINIMAL, engine)
+    with pytest.raises(ValueError, match="not registered"):
+        builder.get_header(1, b"\x00" * 32, b"\xab" * 48)
+
+
+def test_builder_mock_rejects_bad_registration_signature():
+    from lodestar_tpu.crypto.bls.api import interop_secret_key
+
+    engine = ExecutionEngineMock(MINIMAL)
+    builder = ExecutionBuilderMock(MINIMAL, engine)
+    sk = interop_secret_key(0)
+    reg = Fields(
+        message=Fields(
+            fee_recipient=b"\x01" * 20, gas_limit=30_000_000, timestamp=1,
+            pubkey=sk.to_public_key().to_bytes(),
+        ),
+        signature=interop_secret_key(1).sign(b"\x00" * 32).to_bytes(),
+    )
+    with pytest.raises(ValueError, match="invalid validator registration"):
+        builder.register_validator([reg])
+
+
+# -- e2e: blinded proposal through the chain -------------------------------
+
+
+def test_blinded_proposal_e2e():
+    """Post-merge dev chain: register all validators with the builder,
+    produce a blinded block, sign it, publish — the chain unblinds via
+    submit_blinded_block and imports the full block; the registered fee
+    recipient lands in the payload."""
+    from lodestar_tpu.state_transition import (
+        clone_state,
+        compute_epoch_at_slot,
+        process_slots,
+    )
+
+    dev, engine, builder = _dev_with_builder()
+    cfg = dev.cfg
+    fee_recipient = b"\xfe" * 20
+
+    async def run():
+        for slot in range(1, 18):  # cross the merge (bellatrix at 16)
+            await dev.advance_slot(slot)
+
+        # register every validator (VC register_validator flow, signed
+        # with the real builder domain)
+        from lodestar_tpu.validator.store import ValidatorStore
+
+        store = ValidatorStore(
+            MINIMAL, cfg, dev.keys,
+            genesis_validators_root=dev.chain.head_state().genesis_validators_root,
+        )
+        regs = [
+            store.sign_validator_registration(i, fee_recipient, 30_000_000, 1)
+            for i in dev.keys
+        ]
+        builder.register_validator(regs)
+
+        # prepareBeaconProposer analog: remember fee recipients
+        for i in dev.keys:
+            dev.chain.beacon_proposer_cache.add(0, i, fee_recipient)
+
+        slot = 18
+        dev.clock.set_slot(slot)
+        head_state = dev.chain.head_state()
+        pre = clone_state(MINIMAL, head_state)
+        ctx = process_slots(MINIMAL, cfg, pre, slot)
+        proposer = ctx.get_beacon_proposer(slot)
+        randao = dev._sign_randao(pre, proposer, compute_epoch_at_slot(MINIMAL, slot))
+
+        block, prop2 = await dev.chain.produce_blinded_block(slot, randao)
+        assert prop2 == proposer
+        assert "execution_payload_header" in block.body
+        sig = dev._sign_block(pre, block, proposer)
+        signed_blinded = Fields(message=block, signature=sig)
+        root = await dev.chain.publish_blinded_block(signed_blinded)
+        return root
+
+    root = asyncio.run(run())
+    assert dev.chain.head_root == root
+    # the imported (unblinded) block carries the builder payload with the
+    # registered fee recipient
+    state = dev.chain.head_state()
+    hdr = state.latest_execution_payload_header
+    assert bytes(hdr.fee_recipient) == fee_recipient
+    assert state.slot == 18
+
+
+def test_produce_blinded_without_builder_raises():
+    engine = ExecutionEngineMock(MINIMAL, genesis_block_hash=b"\x11" * 32)
+    pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.001)
+    dev = DevChain(MINIMAL, _cfg(), 16, pool, execution_engine=engine)
+    with pytest.raises(BlockError, match="no builder"):
+        asyncio.run(dev.chain.produce_blinded_block(1, b"\x00" * 96))

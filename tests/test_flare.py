@@ -1,0 +1,75 @@
+"""flare ops CLI: self-slash commands land real slashings in the op pool.
+
+Reference: packages/flare/src/cmds/selfSlashProposer.ts /
+selfSlashAttester.ts — the slashings must be structurally valid enough
+for the pool to pack them into the next block.
+"""
+
+import asyncio
+
+from lodestar_tpu import flare
+from lodestar_tpu.api import RestApiServer
+from lodestar_tpu.chain.bls_pool import BlsBatchPool
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.node.dev_chain import DevChain
+from lodestar_tpu.params import MINIMAL
+
+CFG = ChainConfig(
+    PRESET_BASE="minimal", SHARD_COMMITTEE_PERIOD=0, MIN_GENESIS_TIME=0,
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
+    ALTAIR_FORK_EPOCH=2**64 - 1, BELLATRIX_FORK_EPOCH=2**64 - 1,
+)
+
+
+def test_self_slash_proposer_flows_into_pool():
+    async def main():
+        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        dev = DevChain(MINIMAL, CFG, 16, pool)
+        server = RestApiServer(MINIMAL, dev.chain)
+        port = await server.listen(0)
+
+        class Args:
+            server = f"http://127.0.0.1:{port}"
+            preset = "minimal"
+            index_start = 3
+            count = 2
+            slot = 1
+
+        sent = await flare.self_slash_proposer(Args)
+        assert sent == 2
+        slashings, _, _ = dev.chain.op_pool.get_slashings_and_exits(
+            dev.chain.head_state()
+        )
+        assert {s.signed_header_1.message.proposer_index for s in slashings} == {3, 4}
+        await server.close()
+        return True
+
+    assert asyncio.run(main())
+
+
+def test_self_slash_attester_flows_into_pool():
+    async def main():
+        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        dev = DevChain(MINIMAL, CFG, 16, pool)
+        server = RestApiServer(MINIMAL, dev.chain)
+        port = await server.listen(0)
+
+        class Args:
+            server = f"http://127.0.0.1:{port}"
+            preset = "minimal"
+            index_start = 0
+            count = 3
+            epoch = 0
+
+        sent = await flare.self_slash_attester(Args)
+        assert sent == 1
+        _, att_slashings, _ = dev.chain.op_pool.get_slashings_and_exits(
+            dev.chain.head_state()
+        )
+        assert len(att_slashings) == 1
+        assert list(att_slashings[0].attestation_1.attesting_indices) == [0, 1, 2]
+        await server.close()
+        return True
+
+    assert asyncio.run(main())

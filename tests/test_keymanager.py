@@ -103,3 +103,111 @@ def test_import_and_delete_roundtrip():
     # deleting again -> not_found
     again2 = api.delete_keystores({"pubkeys": ["0x" + pk7.hex()]})
     assert again2["data"][0]["status"] == "not_found"
+
+
+def test_remotekeys_crud():
+    """remotekeys namespace (keymanager routes.ts remote-key CRUD): import
+    registers signer-backed pubkeys, list shows only non-local keys,
+    delete removes them."""
+    from lodestar_tpu.validator.remote_signer import RemoteSignerClient
+
+    store, protection = _store(indices=(0,))
+    store.remote_signer = RemoteSignerClient("http://127.0.0.1:9999")
+    api = KeymanagerApi(store, protection)
+
+    pk2 = interop_secret_key(2).to_public_key().to_bytes()
+    out = api.import_remote_keys(
+        {"remote_keys": [{"pubkey": "0x" + pk2.hex(), "url": "http://127.0.0.1:9999"}]}
+    )
+    assert out["data"][0]["status"] == "imported"
+    # duplicate import reports duplicate
+    out = api.import_remote_keys({"remote_keys": [{"pubkey": "0x" + pk2.hex()}]})
+    assert out["data"][0]["status"] == "duplicate"
+
+    listing = api.list_remote_keys()
+    assert [e["pubkey"] for e in listing["data"]] == ["0x" + pk2.hex()]
+    # the local keystore key is NOT a remote key
+    assert all(
+        e["pubkey"] != "0x" + store.pubkeys[0].hex() for e in listing["data"]
+    )
+
+    out = api.delete_remote_keys({"pubkeys": ["0x" + pk2.hex()]})
+    assert out["data"][0]["status"] == "deleted"
+    assert api.list_remote_keys()["data"] == []
+    # deleting a local (keystore) key via remotekeys is not_found
+    out = api.delete_remote_keys({"pubkeys": ["0x" + store.pubkeys[0].hex()]})
+    assert out["data"][0]["status"] == "not_found"
+
+
+def test_import_remote_key_without_signer_errors():
+    store, protection = _store(indices=(0,))
+    api = KeymanagerApi(store, protection)
+    pk = interop_secret_key(5).to_public_key().to_bytes()
+    out = api.import_remote_keys({"remote_keys": [{"pubkey": "0x" + pk.hex()}]})
+    assert out["data"][0]["status"] == "error"
+
+
+def test_fee_recipient_and_gas_limit_routes():
+    """Per-validator feerecipient/gas_limit overrides with VC defaults
+    (keymanager routes.ts listFeeRecipient/setFeeRecipient/...)."""
+
+    class FakeClient:
+        fee_recipient = b"\xaa" * 20
+        gas_limit = 25_000_000
+        fee_recipient_overrides = {}
+        gas_limit_overrides = {}
+
+    store, protection = _store(indices=(0,))
+    api = KeymanagerApi(store, protection, client=FakeClient())
+    pk_hex = "0x" + store.pubkeys[0].hex()
+
+    # default from the client
+    assert api.get_fee_recipient(pk_hex)["data"]["ethaddress"] == "0x" + "aa" * 20
+    assert api.get_gas_limit(pk_hex)["data"]["gas_limit"] == "25000000"
+    # override + delete
+    api.set_fee_recipient(pk_hex, {"ethaddress": "0x" + "bb" * 20})
+    assert api.get_fee_recipient(pk_hex)["data"]["ethaddress"] == "0x" + "bb" * 20
+    api.delete_fee_recipient(pk_hex)
+    assert api.get_fee_recipient(pk_hex)["data"]["ethaddress"] == "0x" + "aa" * 20
+    api.set_gas_limit(pk_hex, {"gas_limit": "31000000"})
+    assert api.get_gas_limit(pk_hex)["data"]["gas_limit"] == "31000000"
+
+
+def test_overrides_drive_client_and_placeholders_never_collide():
+    """Review fixes: (1) feerecipient/gas_limit POSTs must reach the
+    ValidatorClient services, not just the GET routes; (2) placeholder
+    indices stay unique across import/delete cycles."""
+    from lodestar_tpu.validator.remote_signer import RemoteSignerClient
+
+    class FakeClient:
+        fee_recipient = b"\xaa" * 20
+        gas_limit = 30_000_000
+        fee_recipient_overrides = {}
+        gas_limit_overrides = {}
+
+    store, protection = _store(indices=(0,))
+    store.remote_signer = RemoteSignerClient("http://127.0.0.1:9999")
+    client = FakeClient()
+    api = KeymanagerApi(store, protection, client=client)
+
+    pk_hex = "0x" + store.pubkeys[0].hex()
+    api.set_fee_recipient(pk_hex, {"ethaddress": "0x" + "cc" * 20})
+    assert client.fee_recipient_overrides[store.pubkeys[0]] == b"\xcc" * 20
+    api.set_gas_limit(pk_hex, {"gas_limit": "31000000"})
+    assert client.gas_limit_overrides[store.pubkeys[0]] == 31_000_000
+    api.delete_fee_recipient(pk_hex)
+    api.delete_gas_limit(pk_hex)
+    assert store.pubkeys[0] not in client.fee_recipient_overrides
+    assert store.pubkeys[0] not in client.gas_limit_overrides
+
+    # placeholder collision regression: import A, B; delete A; import C —
+    # B must survive
+    def pk(i):
+        return "0x" + interop_secret_key(i).to_public_key().to_bytes().hex()
+
+    api.import_remote_keys({"remote_keys": [{"pubkey": pk(2)}]})
+    api.import_remote_keys({"remote_keys": [{"pubkey": pk(3)}]})
+    api.delete_remote_keys({"pubkeys": [pk(2)]})
+    api.import_remote_keys({"remote_keys": [{"pubkey": pk(4)}]})
+    listed = {e["pubkey"] for e in api.list_remote_keys()["data"]}
+    assert listed == {pk(3), pk(4)}
