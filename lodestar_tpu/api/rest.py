@@ -191,6 +191,16 @@ class RestApiServer:
         r("GET", "/eth/v1/node/health", lambda pp, q, b: {})
         r("GET", "/eth/v1/node/version", lambda pp, q, b: {"data": {"version": VERSION}})
         r("GET", "/eth/v1/node/syncing", self._syncing)
+        # node/peers + identity (routes/node.ts getPeers/getPeerCount)
+        r("GET", "/eth/v1/node/peers", self._peers)
+        r("GET", "/eth/v1/node/peers/{peer_id}", self._peer)
+        r("GET", "/eth/v1/node/peer_count", self._peer_count)
+        r("GET", "/eth/v1/node/identity", self._identity)
+        # config namespace (routes/config.ts getSpec/getDepositContract/
+        # getForkSchedule)
+        r("GET", "/eth/v1/config/spec", self._config_spec)
+        r("GET", "/eth/v1/config/fork_schedule", self._fork_schedule)
+        r("GET", "/eth/v1/config/deposit_contract", self._deposit_contract)
         r("GET", "/eth/v1/beacon/genesis", self._genesis)
         r("GET", "/eth/v1/beacon/states/{state_id}/finality_checkpoints", self._finality)
         r("GET", "/eth/v1/beacon/states/{state_id}/validators/{validator_id}", self._validator)
@@ -230,6 +240,112 @@ class RestApiServer:
         r("POST", "/eth/v1/validator/beacon_committee_subscriptions", self._committee_subs)
         r("POST", "/eth/v1/validator/sync_committee_subscriptions", self._sync_subs)
         r("GET", "/metrics", self._metrics)
+
+    # -- node/peers + config namespaces ----------------------------------------
+
+    def _peer_json(self, p) -> dict:
+        # remote_key is "host:port" for dialed peers, a bare host for
+        # inbound, or the synthetic peer id when peername was unavailable;
+        # render whatever we have as a spec-shaped multiaddr
+        host, _, port = str(p.remote_key).partition(":")
+        addr = f"/ip4/{host}/tcp/{port or 0}" if host and "-" not in host else ""
+        return {
+            "peer_id": p.peer_id,
+            "enr": "",
+            "last_seen_p2p_address": addr,
+            "state": "connected",
+            "direction": "outbound",
+        }
+
+    def _peers(self, pp, q, b):
+        peers = self.network.peer_manager.connected() if self.network else []
+        data = [self._peer_json(p) for p in peers]
+        # spec query filters (routes/node.ts getPeers): we only track
+        # currently-connected peers, so any other state filter is empty
+        states = q.get("state", "").split(",") if q.get("state") else None
+        directions = q.get("direction", "").split(",") if q.get("direction") else None
+        if states is not None:
+            data = [d for d in data if d["state"] in states]
+        if directions is not None:
+            data = [d for d in data if d["direction"] in directions]
+        return {"data": data, "meta": {"count": len(data)}}
+
+    def _peer(self, pp, q, b):
+        if self.network is not None:
+            p = self.network.peer_manager.get(pp["peer_id"])
+            if p is not None:
+                return {"data": self._peer_json(p)}
+        raise ApiError(404, "peer not found")
+
+    def _peer_count(self, pp, q, b):
+        n = len(self.network.peer_manager.connected()) if self.network else 0
+        return {
+            "data": {
+                "disconnected": "0", "connecting": "0",
+                "connected": str(n), "disconnecting": "0",
+            }
+        }
+
+    def _identity(self, pp, q, b):
+        net = self.network
+        addr = (
+            f"/ip4/{getattr(net, 'host', '127.0.0.1')}/tcp/{net.port}"
+            if net is not None and getattr(net, "port", None)
+            else ""
+        )
+        return {
+            "data": {
+                "peer_id": getattr(net, "local_peer_id", "") if net else "",
+                "enr": "",
+                "p2p_addresses": [addr] if addr else [],
+                "discovery_addresses": [],
+                "metadata": {"seq_number": "0", "attnets": "0x" + "00" * 8},
+            }
+        }
+
+    @staticmethod
+    def _spec_value(v):
+        if isinstance(v, bytes):
+            return "0x" + v.hex()
+        if isinstance(v, bool):
+            return "1" if v else "0"
+        if isinstance(v, int):
+            return str(v)
+        return str(v)
+
+    def _config_spec(self, pp, q, b):
+        """Flattened preset + chain config, every value a string
+        (routes/config.ts getSpec — clients feed this to their own
+        domain/config machinery)."""
+        import dataclasses as _dc
+
+        out = {}
+        for src in (self.p, self.chain.cfg):
+            for f in _dc.fields(src):
+                out[f.name] = self._spec_value(getattr(src, f.name))
+        return {"data": out}
+
+    def _fork_schedule(self, pp, q, b):
+        forks = self.chain.fork_config.forks_ascending
+        return {
+            "data": [
+                {
+                    "previous_version": "0x" + f.prev_version.hex(),
+                    "current_version": "0x" + f.version.hex(),
+                    "epoch": str(f.epoch),
+                }
+                for f in forks
+            ]
+        }
+
+    def _deposit_contract(self, pp, q, b):
+        cfg = self.chain.cfg
+        return {
+            "data": {
+                "chain_id": str(cfg.DEPOSIT_CHAIN_ID),
+                "address": "0x" + cfg.DEPOSIT_CONTRACT_ADDRESS.hex(),
+            }
+        }
 
     def _state_for(self, state_id: str):
         chain = self.chain

@@ -155,6 +155,14 @@ def _sub_pad(w: int) -> np.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def _digit(x: jnp.ndarray, i: int) -> jnp.ndarray:
+    """x[..., i:i+1] as an explicit slice.  The ``x[..., i, None]`` idiom
+    lowers to a rank-N gather, which XLA handles but Mosaic (Pallas TPU)
+    cannot (>2D gathers unsupported); a slice is identical numerically and
+    keeps every op in this module fusible into a Pallas kernel."""
+    return lax.slice_in_dim(x, i, i + 1, axis=-1)
+
+
 def _shift_up(a: jnp.ndarray, d: int) -> jnp.ndarray:
     """result[..., i] = a[..., i-d], zero-filled below — moves carries up."""
     pad = [(0, 0)] * (a.ndim - 1) + [(d, 0)]
@@ -239,7 +247,7 @@ def _fold_tail(y: jnp.ndarray) -> jnp.ndarray:
     # no such downcast path and vectorize over the batch lanes just as well.
     e = jnp.zeros(y.shape[:-1] + (NLIMBS,), dtype=DTYPE)
     for r in range(k):
-        e = e + hi[..., r, None] * jnp.asarray(RED_ROWS[r])
+        e = e + _digit(hi, r) * jnp.asarray(RED_ROWS[r])
     out = jnp.pad(
         y[..., :_FOLD_BASE], [(0, 0)] * (y.ndim - 1) + [(0, NLIMBS - _FOLD_BASE)]
     )
@@ -331,7 +339,7 @@ def fp_mul(
     nd = a.ndim - 1
     rows = []
     for i in range(NLIMBS):
-        seg = a[..., i, None] * b  # (..., 50)
+        seg = _digit(a, i) * b  # (..., 50)
         rows.append(jnp.pad(seg, [(0, 0)] * nd + [(i, NLIMBS - 1 - i)]))
     z = rows[0]
     for r in rows[1:]:
@@ -394,14 +402,14 @@ def fp_reduce_full(a: jnp.ndarray) -> jnp.ndarray:
     # t * mu (4x6 digits): 24 partial products, elementwise shift-accumulate
     z = jnp.zeros(a.shape[:-1] + (11,), dtype=DTYPE)
     for i in range(4):
-        prod = t[..., i, None] * jnp.asarray(_MU)  # (..., 6) f32 exact
+        prod = _digit(t, i) * jnp.asarray(_MU)  # (..., 6) f32 exact
         z = z.at[..., i : i + 6].add(prod)
     z = carry_ripple_exact(z)  # (..., 12) fully strict
     qhat = z[..., 6:9]  # floor(t*mu / 2^48) < 2^20 (3 digits)
     # qhat * p (3x48 digits): 3 shifted rows, columns sum <= 3*2^16 < 2^19
     qp = jnp.zeros(a.shape[:-1] + (NLIMBS + 1,), dtype=DTYPE)
     for i in range(3):
-        prod2 = qhat[..., i, None] * jnp.asarray(_P_48)  # (..., 48)
+        prod2 = _digit(qhat, i) * jnp.asarray(_P_48)  # (..., 48)
         qp = qp.at[..., i : i + 48].add(prod2)
     qp = carry_ripple_exact(qp)[..., : NLIMBS + 1]  # strict 51 digits
     r = _sub_known_ge(x, qp)[..., :NLIMBS]  # < 3p

@@ -10,6 +10,7 @@ import asyncio
 import pytest
 
 from lodestar_tpu.api import ApiClient, RestApiServer
+from lodestar_tpu.api.client import ApiClientError
 from lodestar_tpu.chain.bls_pool import BlsBatchPool
 from lodestar_tpu.chain.handlers import GossipHandlers
 from lodestar_tpu.config.chain_config import ChainConfig
@@ -188,3 +189,47 @@ def test_doppelganger_detection_via_liveness():
         pool.close()
 
     asyncio.run(main())
+
+
+def test_config_and_node_namespaces():
+    """config/spec + fork_schedule + deposit_contract and node/peers
+    routes (routes/config.ts, routes/node.ts)."""
+    async def main():
+        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        dev = DevChain(MINIMAL, CFG, N, pool)
+        server = RestApiServer(MINIMAL, dev.chain)
+        port = await server.listen(0)
+        api = ApiClient("127.0.0.1", port)
+
+        spec = (await api.get("/eth/v1/config/spec"))["data"]
+        # flattened preset + config, stringly-typed per the eth2 API
+        assert spec["SLOTS_PER_EPOCH"] == "8"
+        assert spec["SECONDS_PER_SLOT"] == "12"
+        assert spec["GENESIS_FORK_VERSION"].startswith("0x")
+
+        fs = (await api.get("/eth/v1/config/fork_schedule"))["data"]
+        assert fs and fs[0]["epoch"] == "0"
+
+        dc = (await api.get("/eth/v1/config/deposit_contract"))["data"]
+        assert len(dc["address"]) == 42
+
+        pc = (await api.get("/eth/v1/node/peer_count"))["data"]
+        assert pc["connected"] == "0"
+        peers = await api.get("/eth/v1/node/peers")
+        assert peers["meta"]["count"] == 0
+        ident = (await api.get("/eth/v1/node/identity"))["data"]
+        assert "p2p_addresses" in ident
+        try:
+            await api.get("/eth/v1/node/peers/nonexistent")
+            raise AssertionError("missing peer should 404")
+        except ApiClientError as e:
+            assert e.status == 404
+        # state filter: only "connected" peers are tracked, so any other
+        # filter returns empty
+        filtered = await api.get("/eth/v1/node/peers?state=disconnected")
+        assert filtered["data"] == []
+        await server.close()
+        pool.close()
+        return True
+
+    assert asyncio.run(main())
