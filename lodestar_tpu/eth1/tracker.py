@@ -22,31 +22,43 @@ DEPOSIT_CONTRACT_TREE_DEPTH = 32
 
 
 class DepositTree:
-    """Incremental deposit merkle tree (the deposit contract's scheme)."""
+    """Incremental deposit merkle tree (the deposit contract's scheme):
+    a 32-entry branch array makes push and root O(depth), so replaying a
+    genesis deposit list is O(n log n) total, not O(n^2)."""
 
     def __init__(self):
-        self.leaves: List[bytes] = []
+        self.leaves: List[bytes] = []  # kept for proof construction
         self._zero = [b"\x00" * 32]
         for _ in range(DEPOSIT_CONTRACT_TREE_DEPTH):
             self._zero.append(
                 hashlib.sha256(self._zero[-1] + self._zero[-1]).digest()
             )
+        self._branch: List[bytes] = list(self._zero[:DEPOSIT_CONTRACT_TREE_DEPTH])
 
     def push(self, deposit_data_root: bytes) -> None:
         self.leaves.append(deposit_data_root)
+        size = len(self.leaves)
+        node = deposit_data_root
+        for depth in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            if size % 2 == 1:
+                self._branch[depth] = node
+                return
+            node = hashlib.sha256(self._branch[depth] + node).digest()
+            size //= 2
 
     def root(self) -> bytes:
-        layer = list(self.leaves)
+        # deposit-contract get_deposit_root: fold the branch array against
+        # the zero-subtree frontier
+        size = len(self.leaves)
+        node = self._zero[0]
         for depth in range(DEPOSIT_CONTRACT_TREE_DEPTH):
-            if len(layer) % 2:
-                layer.append(self._zero[depth])
-            layer = [
-                hashlib.sha256(layer[i] + layer[i + 1]).digest()
-                for i in range(0, len(layer), 2)
-            ]
-        root = layer[0] if layer else self._zero[DEPOSIT_CONTRACT_TREE_DEPTH]
+            if size % 2 == 1:
+                node = hashlib.sha256(self._branch[depth] + node).digest()
+            else:
+                node = hashlib.sha256(node + self._zero[depth]).digest()
+            size //= 2
         count = len(self.leaves).to_bytes(8, "little") + b"\x00" * 24
-        return hashlib.sha256(root + count).digest()
+        return hashlib.sha256(node + count).digest()
 
 
 class Eth1ProviderMock:

@@ -1,0 +1,70 @@
+"""Fuse a whole library field op into ONE Pallas TPU kernel.
+
+Motivation (round-4 probes, docs/round4.md "Pallas probes"): the batched
+BLS dispatch is bound by per-HLO-op overhead on the serial critical path
+— a library fq12 op is hundreds of tiny elementwise HLOs costing far
+more dispatch than compute.  Wrapping an op's entire graph in a single
+`pallas_call` removes that overhead: the hand-written fp_mul prototype
+measured ~10 us/op vs the contaminated-but-large XLA figures.
+
+Mechanism: `jax.make_jaxpr` exposes the op's captured numpy constants
+(RED fold table, subtraction pads, ...) as jaxpr consts; those become
+explicit kernel operands, and `eval_jaxpr` replays the op's exact graph
+inside the kernel with ref-read values substituted for the consts.  The
+fused kernel is therefore BIT-IDENTICAL to the library op by
+construction — same jaxpr, different scheduler.
+
+Constraints (Mosaic, the Pallas TPU compiler):
+- no rank-N gathers: ops/limbs.py uses explicit slices (`_digit`);
+- no scatter: the library is scatter-free on the hot path;
+- `interpret=True` runs the same kernel on CPU for tests.
+
+The jit wrappers on library ops must be stripped before tracing (inner
+pjit bodies with constvars fail Mosaic's lowering); `unjitted` does this
+via the functools wrapper chain.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def unjitted(fn: Callable) -> Callable:
+    """The underlying Python function of a possibly-jitted callable."""
+    return getattr(fn, "__wrapped__", fn)
+
+
+def pallas_fuse(fn: Callable, *examples, interpret: bool = False) -> Callable:
+    """Compile `fn(*examples)`'s whole graph as ONE Pallas kernel.
+
+    fn must be unjitted (see `unjitted`) and unary-or-n-ary over arrays
+    of the example shapes; the returned callable is jitted and takes the
+    same number of arrays.
+    """
+    closed = jax.make_jaxpr(fn)(*examples)
+    consts = [jnp.asarray(c) for c in closed.consts]
+    n_in = len(examples)
+    n_const = len(consts)
+    out_avals = closed.out_avals
+    if len(out_avals) != 1:
+        raise ValueError("pallas_fuse supports single-output ops")
+
+    def kernel(*refs):
+        xs = [refs[i][...] for i in range(n_in)]
+        cs = [refs[n_in + i][...] for i in range(n_const)]
+        out = jax.core.eval_jaxpr(closed.jaxpr, cs, *xs)
+        refs[-1][...] = out[0]
+
+    @jax.jit
+    def run(*xs):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(out_avals[0].shape, out_avals[0].dtype),
+            interpret=interpret,
+        )(*xs, *consts)
+
+    return run

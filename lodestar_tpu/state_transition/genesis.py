@@ -28,15 +28,9 @@ def _sha(b: bytes) -> bytes:
     return hashlib.sha256(b).digest()
 
 
-def interop_genesis_state(
-    p: Preset,
-    cfg: ChainConfig,
-    validator_count: int,
-    genesis_time: int = 1_578_009_600,
-):
-    """Deterministic genesis with interop keys, all validators active at
-    genesis — the dev-chain / sim-test starting point (reference:
-    getDevBeaconNode interop genesis, SURVEY §4.4)."""
+def _genesis_scaffold(p: Preset, cfg: ChainConfig, genesis_time: int, randao_fill: bytes):
+    """The state skeleton both genesis paths share: fork record, default-
+    body latest_block_header, filled randao mixes."""
     t = get_types(p).phase0
     state = t.BeaconState.default()
     state.genesis_time = genesis_time
@@ -53,7 +47,20 @@ def interop_genesis_state(
         state_root=b"\x00" * 32,
         body_root=body_root,
     )
-    state.randao_mixes = [b"\x42" * 32] * p.EPOCHS_PER_HISTORICAL_VECTOR
+    state.randao_mixes = [randao_fill] * p.EPOCHS_PER_HISTORICAL_VECTOR
+    return state
+
+
+def interop_genesis_state(
+    p: Preset,
+    cfg: ChainConfig,
+    validator_count: int,
+    genesis_time: int = 1_578_009_600,
+):
+    """Deterministic genesis with interop keys, all validators active at
+    genesis — the dev-chain / sim-test starting point (reference:
+    getDevBeaconNode interop genesis, SURVEY §4.4)."""
+    state = _genesis_scaffold(p, cfg, genesis_time, b"\x42" * 32)
 
     for i in range(validator_count):
         sk = interop_secret_key(i)
@@ -80,6 +87,61 @@ def interop_genesis_state(
         block_hash=b"\x01" * 32,
     )
     state.eth1_deposit_index = validator_count
+    return state
+
+
+def initialize_beacon_state_from_eth1(
+    p: Preset,
+    cfg: ChainConfig,
+    eth1_block_hash: bytes,
+    eth1_timestamp: int,
+    deposits,
+):
+    """Spec initialize_beacon_state_from_eth1 (reference
+    state-transition/src/util/genesis.ts initializeBeaconStateFromEth1):
+    replay the deposit list with full merkle-proof verification against
+    an incrementally-updated deposit root, then activate every validator
+    that reached MAX_EFFECTIVE_BALANCE."""
+    from types import SimpleNamespace
+
+    from ..eth1.tracker import DepositTree
+    from .block import process_deposit
+
+    t = get_types(p).phase0
+    state = _genesis_scaffold(
+        p, cfg, eth1_timestamp + cfg.GENESIS_DELAY, bytes(eth1_block_hash)
+    )
+    state.eth1_data = Fields(
+        deposit_root=b"\x00" * 32,
+        deposit_count=len(deposits),
+        block_hash=bytes(eth1_block_hash),
+    )
+
+    # apply_deposit needs only the pubkey->index map (with .set) and the
+    # index2pubkey list of the growing registry — a shim stands in for
+    # the full EpochContext during genesis replay
+    class _PkMap(dict):
+        def set(self, k, v):
+            self[k] = v
+
+    ctx = SimpleNamespace(pubkey2index=_PkMap(), index2pubkey=[])
+    # per spec, the deposit root for proof-checking deposit i covers the
+    # first i+1 leaves; the incremental tree keeps replay O(n log n)
+    tree = DepositTree()
+    for deposit in deposits:
+        tree.push(t.DepositData.hash_tree_root(deposit.data))
+        state.eth1_data.deposit_root = tree.root()
+        process_deposit(p, cfg, ctx, state, deposit)
+
+    # process activations
+    for index, v in enumerate(state.validators):
+        balance = state.balances[index]
+        eff = min(balance - balance % p.EFFECTIVE_BALANCE_INCREMENT, p.MAX_EFFECTIVE_BALANCE)
+        v.effective_balance = eff
+        if eff == p.MAX_EFFECTIVE_BALANCE:
+            v.activation_eligibility_epoch = GENESIS_EPOCH
+            v.activation_epoch = GENESIS_EPOCH
+    state.genesis_validators_root = _genesis_validators_root(p, state)
     return state
 
 

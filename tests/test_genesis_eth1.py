@@ -1,0 +1,78 @@
+"""Genesis from eth1 deposits: initialize_beacon_state_from_eth1 replays
+the deposit list with real merkle proofs and activates full validators.
+
+Reference: packages/state-transition/src/util/genesis.ts
+initializeBeaconStateFromEth1; spec initialize_beacon_state_from_eth1.
+"""
+
+from lodestar_tpu.config.chain_config import ChainConfig
+from lodestar_tpu.params import GENESIS_EPOCH, MINIMAL
+from lodestar_tpu.ssz import Fields
+from lodestar_tpu.state_transition.genesis import (
+    initialize_beacon_state_from_eth1,
+    is_valid_genesis_state,
+)
+from lodestar_tpu.types import get_types
+
+CFG = ChainConfig(
+    PRESET_BASE="minimal", MIN_GENESIS_TIME=0, GENESIS_DELAY=300,
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=4,
+)
+T = get_types(MINIMAL).phase0
+
+
+from lodestar_tpu.spec_test_util.deposits import (
+    build_deposits,
+    deposit_proof,
+    make_deposit_data,
+)
+
+
+def test_genesis_from_eth1_deposits():
+    deposits = build_deposits(MINIMAL, CFG, 4)
+    state = initialize_beacon_state_from_eth1(
+        MINIMAL, CFG, b"\x12" * 32, 1_000_000, deposits
+    )
+    assert len(state.validators) == 4
+    assert state.genesis_time == 1_000_000 + CFG.GENESIS_DELAY
+    for v in state.validators:
+        assert v.activation_epoch == GENESIS_EPOCH
+        assert v.effective_balance == MINIMAL.MAX_EFFECTIVE_BALANCE
+    assert state.eth1_deposit_index == 4
+    assert bytes(state.genesis_validators_root) != b"\x00" * 32
+    assert is_valid_genesis_state(MINIMAL, CFG, state)
+
+
+def test_genesis_top_up_and_underfunded():
+    """A repeated pubkey tops up; an underfunded validator stays
+    inactive (spec activation condition: effective == MAX)."""
+    amounts = {2: MINIMAL.MAX_EFFECTIVE_BALANCE // 2}
+    deposits = build_deposits(MINIMAL, CFG, 3, amounts)
+    # 4th deposit: top-up for validator 0
+    top_up = make_deposit_data(MINIMAL, CFG, 0, MINIMAL.MAX_EFFECTIVE_BALANCE // 4)
+    datas = [d.data for d in deposits] + [top_up]
+    leaves = [T.DepositData.hash_tree_root(d) for d in datas]
+    all_deposits = [
+        Fields(proof=deposit_proof(leaves, i, i + 1), data=datas[i])
+        for i in range(4)
+    ]
+    state = initialize_beacon_state_from_eth1(
+        MINIMAL, CFG, b"\x12" * 32, 5, all_deposits
+    )
+    assert len(state.validators) == 3  # top-up adds no validator
+    assert state.balances[0] == MINIMAL.MAX_EFFECTIVE_BALANCE * 5 // 4
+    assert state.validators[0].effective_balance == MINIMAL.MAX_EFFECTIVE_BALANCE
+    assert state.validators[2].activation_epoch != GENESIS_EPOCH  # underfunded
+    # MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=4 not met -> invalid genesis
+    assert not is_valid_genesis_state(MINIMAL, CFG, state)
+
+
+def test_genesis_invalid_proof_rejected():
+    import pytest
+
+    from lodestar_tpu.state_transition.block import BlockProcessingError
+
+    deposits = build_deposits(MINIMAL, CFG, 2)
+    deposits[1].proof[0] = b"\xff" * 32
+    with pytest.raises(BlockProcessingError, match="merkle"):
+        initialize_beacon_state_from_eth1(MINIMAL, CFG, b"\x12" * 32, 5, deposits)

@@ -237,6 +237,109 @@ def test_fork_and_transition_vectors():
         assert _roots_equal(post, case, fork="altair"), f"transition {case.name}"
 
 
+def test_genesis_vectors():
+    """genesis/initialization + genesis/validity (presets/genesis.ts)."""
+    from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG as gcfg
+    from lodestar_tpu.state_transition.genesis import (
+        initialize_beacon_state_from_eth1,
+        is_valid_genesis_state,
+    )
+    t = get_types(MINIMAL).phase0
+    init_cases = collect_spec_test_cases(
+        "genesis", "initialization", config="minimal", fork="phase0"
+    )
+    if not init_cases:
+        pytest.skip("no genesis vectors")
+    for case_dir in init_cases:
+        case = load_spec_test_case(case_dir)
+        eth1 = case.files["eth1"]
+        deposits = [
+            t.Deposit.deserialize(case.files[f"deposits_{i}"])
+            for i in range(case.files["meta"]["deposits_count"])
+        ]
+        state = initialize_beacon_state_from_eth1(
+            MINIMAL, gcfg,
+            bytes.fromhex(eth1["eth1_block_hash"][2:]),
+            eth1["eth1_timestamp"], deposits,
+        )
+        assert t.BeaconState.serialize(state) == case.files["state"], case.name
+
+    for case_dir in collect_spec_test_cases(
+        "genesis", "validity", config="minimal", fork="phase0"
+    ):
+        case = load_spec_test_case(case_dir)
+        state = t.BeaconState.deserialize(case.files["genesis"])
+        assert is_valid_genesis_state(MINIMAL, gcfg, state) == case.files["is_valid"]
+
+
+def test_merkle_vectors():
+    """merkle/single_proof (presets/merkle.ts): the branch must verify
+    against the state root at the generalized index."""
+    from lodestar_tpu.state_transition.block import is_valid_merkle_branch
+
+    cases = collect_spec_test_cases("merkle", "single_proof", config="minimal", fork="phase0")
+    if not cases:
+        pytest.skip("no merkle vectors")
+    t = get_types(MINIMAL).phase0
+    for case_dir in cases:
+        case = load_spec_test_case(case_dir)
+        state = t.BeaconState.deserialize(case.files["state"])
+        proof = case.files["proof"]
+        branch = [bytes.fromhex(b[2:]) for b in proof["branch"]]
+        gindex = proof["leaf_index"]
+        depth = gindex.bit_length() - 1
+        index = gindex - (1 << depth)
+        assert is_valid_merkle_branch(
+            bytes.fromhex(proof["leaf"][2:]), branch, depth, index,
+            t.BeaconState.hash_tree_root(state),
+        ), case.name
+
+
+def test_fork_choice_vectors():
+    """fork_choice/on_block step vectors (presets/fork_choice.ts): replay
+    anchor + ticks + blocks into a fresh chain, assert the head checks."""
+    import asyncio
+
+    from lodestar_tpu.chain.beacon_chain import BeaconChain
+    from lodestar_tpu.chain.bls_pool import BlsBatchPool
+    from lodestar_tpu.chain.clock import ManualClock
+    from lodestar_tpu.config.chain_config import ChainConfig
+    from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+
+    cases = collect_spec_test_cases("fork_choice", "on_block", config="minimal", fork="phase0")
+    if not cases:
+        pytest.skip("no fork_choice vectors")
+    cfg = ChainConfig(
+        PRESET_BASE="minimal", MIN_GENESIS_TIME=0, SHARD_COMMITTEE_PERIOD=0,
+        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
+        ALTAIR_FORK_EPOCH=2**64 - 1, BELLATRIX_FORK_EPOCH=2**64 - 1,
+    )
+    t = get_types(MINIMAL).phase0
+
+    async def run_case(case):
+        anchor = t.BeaconState.deserialize(case.files["anchor_state"])
+        clock = ManualClock(
+            int(anchor.genesis_time), cfg.SECONDS_PER_SLOT, MINIMAL.SLOTS_PER_EPOCH
+        )
+        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.001)
+        chain = BeaconChain(MINIMAL, cfg, anchor, pool, clock=clock)
+        for step in case.files["steps"]:
+            if "tick" in step:
+                slot = (step["tick"] - int(anchor.genesis_time)) // cfg.SECONDS_PER_SLOT
+                clock.set_slot(slot)
+            elif "block" in step:
+                signed = t.SignedBeaconBlock.deserialize(case.files[step["block"]])
+                await chain.process_block(signed)
+            elif "checks" in step:
+                head = step["checks"]["head"]
+                assert chain.head_root.hex() == head["root"][2:], case.name
+                assert int(chain.head_state().slot) == head["slot"], case.name
+        pool.close()
+
+    for case_dir in cases:
+        asyncio.run(run_case(load_spec_test_case(case_dir)))
+
+
 def test_vector_coverage():
     """checkCoverage.ts analog: every wired category must have at least
     one case when the tree is present — an accidentally-empty directory
@@ -249,6 +352,10 @@ def test_vector_coverage():
         ("operations", "block_header", "phase0"),
         ("shuffling", "core", "phase0"),
         ("ssz_static", "BeaconState", "phase0"),
+        ("genesis", "initialization", "phase0"),
+        ("genesis", "validity", "phase0"),
+        ("merkle", "single_proof", "phase0"),
+        ("fork_choice", "on_block", "phase0"),
         ("fork", "fork", "altair"),
         ("transition", "core", "altair"),
     ] + [("epoch_processing", h, "phase0") for h in _EPOCH_HANDLERS]

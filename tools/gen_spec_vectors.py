@@ -324,6 +324,121 @@ def gen_ssz_static_and_shuffling(dev: DevChain) -> None:
         )
 
 
+def gen_genesis() -> None:
+    """genesis/initialization + genesis/validity (official format:
+    eth1.yaml, deposits_<i>.ssz_snappy, meta.yaml, expected state;
+    validity cases carry genesis.ssz_snappy + is_valid.yaml)."""
+    from lodestar_tpu.spec_test_util.deposits import build_deposits
+    from lodestar_tpu.state_transition.genesis import (
+        initialize_beacon_state_from_eth1,
+        is_valid_genesis_state,
+    )
+
+    # the REAL minimal chain config: official vectors sign deposits over
+    # GENESIS_FORK_VERSION 0x00000001 and judge validity at 64 validators,
+    # so anything else would make the runner non-conformant
+    from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG as gcfg
+
+    deposits = build_deposits(MINIMAL, gcfg, gcfg.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT)
+    eth1_block_hash = b"\x12" * 32
+    eth1_timestamp = gcfg.MIN_GENESIS_TIME
+    state = initialize_beacon_state_from_eth1(
+        MINIMAL, gcfg, eth1_block_hash, eth1_timestamp, deposits
+    )
+    d = case_dir("phase0", "genesis", "initialization", "pyspec_tests", "case_0")
+    write_yaml(d, "eth1", {
+        "eth1_block_hash": "0x" + eth1_block_hash.hex(),
+        "eth1_timestamp": eth1_timestamp,
+    })
+    write_yaml(d, "meta", {"deposits_count": len(deposits)})
+    for i, dep in enumerate(deposits):
+        write_ssz(d, f"deposits_{i}", T.phase0.Deposit.serialize(dep))
+    write_ssz(d, "state", state_bytes("phase0", state))
+
+    for name, st, valid in (
+        ("valid_genesis", state, True),
+        (
+            "invalid_too_few",
+            initialize_beacon_state_from_eth1(
+                MINIMAL, gcfg, eth1_block_hash, eth1_timestamp,
+                build_deposits(MINIMAL, gcfg, 2),
+            ),
+            False,
+        ),
+    ):
+        d = case_dir("phase0", "genesis", "validity", "pyspec_tests", name)
+        write_ssz(d, "genesis", state_bytes("phase0", st))
+        write_yaml(d, "is_valid", valid)
+
+
+def gen_merkle(dev: DevChain) -> None:
+    """merkle/single_proof: a state-field branch in the official
+    proof.yaml shape (leaf, generalized leaf_index, branch)."""
+    state = dev.chain.head_state()
+    st_type = T.phase0.BeaconState
+    for field in ("finalized_checkpoint", "validators"):
+        leaf, branch = st_type.get_field_proof(state, field)
+        nfields = len(st_type.fields)
+        npow2 = 1
+        while npow2 < nfields:
+            npow2 *= 2
+        idx = next(i for i, (f, _) in enumerate(st_type.fields) if f == field)
+        d = case_dir("phase0", "merkle", "single_proof", "pyspec_tests", field)
+        write_ssz(d, "state", state_bytes("phase0", state))
+        write_yaml(d, "proof", {
+            "leaf": "0x" + bytes(leaf).hex(),
+            "leaf_index": npow2 + idx,
+            "branch": ["0x" + bytes(b).hex() for b in branch],
+        })
+
+
+async def gen_fork_choice() -> None:
+    """fork_choice/on_block step vectors: anchor + blocks + ticks +
+    head/finality checks, including a competing-fork scenario (two
+    chains from one genesis; the vector replays A's then B's blocks and
+    pins the head after each)."""
+    a = await build_chain(CFG, 0)
+    b = await build_chain(CFG, 0)  # same interop genesis -> same anchor
+    spe = MINIMAL.SLOTS_PER_EPOCH
+    # A: attested canonical chain (advance_slot packs attestations into
+    # the next block, so the replayed blocks carry LMD weight)
+    for slot in range(1, spe + 3):
+        await a.advance_slot(slot)
+    blocks_a = canonical_blocks(a, 1, spe + 2)
+    # B diverges: skips slot 1, builds a shorter unattested fork
+    blocks_b = []
+    for slot in range(2, spe):
+        blocks_b.append(await b.produce_and_import_block(slot))
+
+    d = case_dir("phase0", "fork_choice", "on_block", "pyspec_tests", "chain_with_fork")
+    anchor = a.chain.genesis_state
+    write_ssz(d, "anchor_state", state_bytes("phase0", anchor))
+    anchor_block = Fields(
+        slot=0, proposer_index=0, parent_root=b"\x00" * 32,
+        state_root=T.phase0.BeaconState.hash_tree_root(anchor),
+        body=T.phase0.BeaconBlockBody.default(),
+    )
+    write_ssz(d, "anchor_block", T.phase0.BeaconBlock.serialize(anchor_block))
+    steps = []
+    genesis_time = int(anchor.genesis_time)
+    for i, blk in enumerate(blocks_a + blocks_b):
+        write_ssz(d, f"block_{i}", block_bytes("phase0", blk))
+        steps.append({
+            "tick": genesis_time + int(blk.message.slot) * CFG.SECONDS_PER_SLOT
+        })
+        steps.append({"block": f"block_{i}"})
+    # the attested chain A must win over B's fork
+    steps.append({
+        "checks": {
+            "head": {
+                "slot": int(a.chain.head_state().slot),
+                "root": "0x" + a.chain.head_root.hex(),
+            },
+        }
+    })
+    write_yaml(d, "steps", steps)
+
+
 async def main() -> None:
     if os.path.isdir(ROOT):
         shutil.rmtree(ROOT)
@@ -333,6 +448,9 @@ async def main() -> None:
     gen_epoch_processing(dev)
     gen_operations(dev)
     gen_ssz_static_and_shuffling(dev)
+    gen_genesis()
+    gen_merkle(dev)
+    await gen_fork_choice()
     dev_altair = await build_chain(CFG_ALTAIR, 2 * MINIMAL.SLOTS_PER_EPOCH + 1)
     gen_transition(dev_altair)
     n = sum(len(files) for _, _, files in os.walk(ROOT))
