@@ -205,11 +205,12 @@ def weigh_justification_and_finalization(
 # -- rewards / penalties -----------------------------------------------------
 
 
-def get_attestation_deltas(p: Preset, cfg: ChainConfig, state, flags: EpochFlags):
-    """Vectorized phase0 get_attestation_deltas."""
+def get_attestation_component_deltas(p: Preset, cfg: ChainConfig, state, flags: EpochFlags):
+    """Vectorized phase0 attestation deltas, split into the spec's five
+    components (source/target/head, inclusion_delay, inactivity) — the
+    shapes the official rewards vectors pin individually (reference
+    getAttestationDeltas / spec get_*_deltas)."""
     n = len(flags.effective_balance)
-    rewards = np.zeros(n, dtype=np.int64)
-    penalties = np.zeros(n, dtype=np.int64)
 
     total = flags.total_active_balance
     sqrt_total = integer_squareroot(total)
@@ -222,11 +223,14 @@ def get_attestation_deltas(p: Preset, cfg: ChainConfig, state, flags: EpochFlags
     finality_delay = flags.previous_epoch - state.finalized_checkpoint.epoch
     is_inactivity_leak = finality_delay > p.MIN_EPOCHS_TO_INACTIVITY_PENALTY
 
-    for attesting, balance_key in (
+    components = {}
+    for attesting, key in (
         (flags.prev_source, "source"),
         (flags.prev_target, "target"),
         (flags.prev_head, "head"),
     ):
+        rewards = np.zeros(n, dtype=np.int64)
+        penalties = np.zeros(n, dtype=np.int64)
         unslashed = attesting & eligible
         attesting_balance = int(flags.effective_balance[attesting].sum())
         if is_inactivity_leak:
@@ -236,8 +240,10 @@ def get_attestation_deltas(p: Preset, cfg: ChainConfig, state, flags: EpochFlags
             reward_numerator = base_reward * (attesting_balance // increment)
             rewards[unslashed] += (reward_numerator // (total // increment))[unslashed]
         penalties[eligible & ~attesting] += base_reward[eligible & ~attesting]
+        components[key] = (rewards, penalties)
 
     # proposer + inclusion delay micro-rewards (for source attesters)
+    rewards = np.zeros(n, dtype=np.int64)
     has_delay = (flags.inclusion_delay > 0) & flags.prev_source & eligible
     for vi in np.nonzero(has_delay)[0]:
         pi = int(flags.proposer_index[vi])
@@ -245,14 +251,28 @@ def get_attestation_deltas(p: Preset, cfg: ChainConfig, state, flags: EpochFlags
             rewards[pi] += int(proposer_reward[vi])
         max_attester_reward = int(base_reward[vi] - proposer_reward[vi])
         rewards[vi] += max_attester_reward // int(flags.inclusion_delay[vi])
+    components["inclusion_delay"] = (rewards, np.zeros(n, dtype=np.int64))
 
+    penalties = np.zeros(n, dtype=np.int64)
     if is_inactivity_leak:
         penalties[eligible] += (BASE_REWARDS_PER_EPOCH * base_reward - proposer_reward)[eligible]
         not_target = eligible & ~flags.prev_target
         penalties[not_target] += (
             eb[not_target] * finality_delay // p.INACTIVITY_PENALTY_QUOTIENT
         )
+    components["inactivity"] = (np.zeros(n, dtype=np.int64), penalties)
+    return components
 
+
+def get_attestation_deltas(p: Preset, cfg: ChainConfig, state, flags: EpochFlags):
+    """Combined phase0 get_attestation_deltas (sum of the components)."""
+    components = get_attestation_component_deltas(p, cfg, state, flags)
+    n = len(flags.effective_balance)
+    rewards = np.zeros(n, dtype=np.int64)
+    penalties = np.zeros(n, dtype=np.int64)
+    for r, pen in components.values():
+        rewards += r
+        penalties += pen
     return rewards, penalties
 
 

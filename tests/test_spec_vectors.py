@@ -237,6 +237,53 @@ def test_fork_and_transition_vectors():
         assert _roots_equal(post, case, fork="altair"), f"transition {case.name}"
 
 
+def test_rewards_vectors():
+    """rewards/basic: recompute the five delta components from pre and
+    compare each pinned Deltas file (presets/rewards.ts)."""
+    from lodestar_tpu.config.chain_config import ChainConfig
+    from lodestar_tpu.ssz import Container, List, uint64
+    from lodestar_tpu.state_transition import EpochContext
+    from lodestar_tpu.state_transition.epoch import (
+        before_process_epoch,
+        get_attestation_component_deltas,
+    )
+
+    cases = collect_spec_test_cases("rewards", "basic", config="minimal", fork="phase0")
+    if not cases:
+        pytest.skip("no rewards vectors")
+    cfg = ChainConfig(
+        PRESET_BASE="minimal", MIN_GENESIS_TIME=0, SHARD_COMMITTEE_PERIOD=0,
+        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
+    )
+    dt = Container(
+        "Deltas",
+        [
+            ("rewards", List(uint64, MINIMAL.VALIDATOR_REGISTRY_LIMIT)),
+            ("penalties", List(uint64, MINIMAL.VALIDATOR_REGISTRY_LIMIT)),
+        ],
+    )
+    names = {
+        "source": "source_deltas", "target": "target_deltas",
+        "head": "head_deltas", "inclusion_delay": "inclusion_delay_deltas",
+        "inactivity": "inactivity_penalty_deltas",
+    }
+    for case_dir in cases:
+        case = load_spec_test_case(case_dir)
+        pre = _state_of(case, "pre")
+        ctx = EpochContext.create_from_state(MINIMAL, pre)
+        flags = before_process_epoch(MINIMAL, ctx, pre)
+        components = get_attestation_component_deltas(MINIMAL, cfg, pre, flags)
+        for key, stem in names.items():
+            want = dt.deserialize(case.files[stem])
+            rewards, penalties = components[key]
+            assert [int(x) for x in rewards] == [int(x) for x in want.rewards], (
+                f"{case.name}/{stem} rewards"
+            )
+            assert [int(x) for x in penalties] == [int(x) for x in want.penalties], (
+                f"{case.name}/{stem} penalties"
+            )
+
+
 def test_genesis_vectors():
     """genesis/initialization + genesis/validity (presets/genesis.ts)."""
     from lodestar_tpu.config.chain_config import MINIMAL_CHAIN_CONFIG as gcfg
@@ -355,6 +402,7 @@ def test_vector_coverage():
         ("genesis", "initialization", "phase0"),
         ("genesis", "validity", "phase0"),
         ("merkle", "single_proof", "phase0"),
+        ("rewards", "basic", "phase0"),
         ("fork_choice", "on_block", "phase0"),
         ("fork", "fork", "altair"),
         ("transition", "core", "altair"),

@@ -324,6 +324,51 @@ def gen_ssz_static_and_shuffling(dev: DevChain) -> None:
         )
 
 
+def _deltas_type():
+    from lodestar_tpu.ssz import Container, List, uint64
+
+    return Container(
+        "Deltas",
+        [
+            ("rewards", List(uint64, MINIMAL.VALIDATOR_REGISTRY_LIMIT)),
+            ("penalties", List(uint64, MINIMAL.VALIDATOR_REGISTRY_LIMIT)),
+        ],
+    )
+
+
+def gen_rewards(dev: DevChain) -> None:
+    """rewards/basic: the five per-component delta files the official
+    vectors pin (presets/rewards.ts)."""
+    from lodestar_tpu.state_transition.epoch import (
+        before_process_epoch,
+        get_attestation_component_deltas,
+    )
+
+    slot = 3 * MINIMAL.SLOTS_PER_EPOCH - 1
+    root = dev.chain.fork_choice.proto.get_ancestor(dev.chain.head_root, slot)
+    pre = clone_state(MINIMAL, dev.chain.get_state_by_block_root(root))
+    if pre.slot < slot:
+        process_slots(MINIMAL, CFG, pre, slot)
+    ctx = EpochContext.create_from_state(MINIMAL, pre)
+    flags = before_process_epoch(MINIMAL, ctx, pre)
+    components = get_attestation_component_deltas(MINIMAL, CFG, pre, flags)
+    dt = _deltas_type()
+    d = case_dir("phase0", "rewards", "basic", "pyspec_tests", "mid_chain")
+    write_ssz(d, "pre", state_bytes("phase0", pre))
+    names = {
+        "source": "source_deltas", "target": "target_deltas",
+        "head": "head_deltas", "inclusion_delay": "inclusion_delay_deltas",
+        "inactivity": "inactivity_penalty_deltas",
+    }
+    for key, stem in names.items():
+        rewards, penalties = components[key]
+        write_ssz(
+            d, stem,
+            dt.serialize(Fields(rewards=[int(x) for x in rewards],
+                                penalties=[int(x) for x in penalties])),
+        )
+
+
 def gen_genesis() -> None:
     """genesis/initialization + genesis/validity (official format:
     eth1.yaml, deposits_<i>.ssz_snappy, meta.yaml, expected state;
@@ -448,6 +493,7 @@ async def main() -> None:
     gen_epoch_processing(dev)
     gen_operations(dev)
     gen_ssz_static_and_shuffling(dev)
+    gen_rewards(dev)
     gen_genesis()
     gen_merkle(dev)
     await gen_fork_choice()
