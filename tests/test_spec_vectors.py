@@ -9,9 +9,23 @@ packages/beacon-node/test/spec/presets/*.ts.
 
 import pytest
 
+from lodestar_tpu.config.chain_config import ChainConfig
 from lodestar_tpu.params import MINIMAL
 from lodestar_tpu.spec_test_util import collect_spec_test_cases, load_spec_test_case
 from lodestar_tpu.types import get_types
+
+# ONE copy of each runner config: these must stay field-identical to the
+# generator's CFG / CFG_ALTAIR or vectors silently diverge from runners
+_CFG = ChainConfig(
+    PRESET_BASE="minimal", MIN_GENESIS_TIME=0, SHARD_COMMITTEE_PERIOD=0,
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
+    ALTAIR_FORK_EPOCH=2**64 - 1, BELLATRIX_FORK_EPOCH=2**64 - 1,
+)
+_CFG_ALTAIR = ChainConfig(
+    PRESET_BASE="minimal", MIN_GENESIS_TIME=0, SHARD_COMMITTEE_PERIOD=0,
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
+    ALTAIR_FORK_EPOCH=1, BELLATRIX_FORK_EPOCH=2**64 - 1,
+)
 
 pytestmark = pytest.mark.skipif(
     not collect_spec_test_cases("shuffling", config="minimal", fork="phase0")
@@ -73,11 +87,7 @@ def _apply_blocks(pre, blocks, cfg=None):
     from lodestar_tpu.config.chain_config import ChainConfig
     from lodestar_tpu.state_transition import state_transition
 
-    cfg = cfg or ChainConfig(
-        PRESET_BASE="minimal", MIN_GENESIS_TIME=0, SHARD_COMMITTEE_PERIOD=0,
-        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
-        ALTAIR_FORK_EPOCH=2**64 - 1, BELLATRIX_FORK_EPOCH=2**64 - 1,
-    )
+    cfg = cfg or _CFG
     post = pre
     for b in blocks:
         post, _ = state_transition(
@@ -100,11 +110,7 @@ def test_sanity_vectors(handler):
     cases = collect_spec_test_cases("sanity", handler, config="minimal", fork="phase0")
     if not cases:
         pytest.skip("no sanity vectors")
-    cfg = ChainConfig(
-        PRESET_BASE="minimal", MIN_GENESIS_TIME=0, SHARD_COMMITTEE_PERIOD=0,
-        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
-        ALTAIR_FORK_EPOCH=2**64 - 1, BELLATRIX_FORK_EPOCH=2**64 - 1,
-    )
+    cfg = _CFG
     for case_dir in cases:
         case = load_spec_test_case(case_dir)
         pre = _state_of(case, "pre")
@@ -150,10 +156,7 @@ def test_epoch_processing_vectors(handler):
         process_slashings,
     )
 
-    cfg = ChainConfig(
-        PRESET_BASE="minimal", MIN_GENESIS_TIME=0, SHARD_COMMITTEE_PERIOD=0,
-        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
-    )
+    cfg = _CFG
     fns = {
         "justification_and_finalization": lambda st, fl: process_justification_and_finalization(MINIMAL, st, fl),
         "rewards_and_penalties": lambda st, fl: process_rewards_and_penalties(MINIMAL, cfg, st, fl),
@@ -203,11 +206,7 @@ def test_fork_and_transition_vectors():
     from lodestar_tpu.state_transition import EpochContext
     from lodestar_tpu.state_transition.upgrade import upgrade_state_to_altair
 
-    cfg_altair = ChainConfig(
-        PRESET_BASE="minimal", MIN_GENESIS_TIME=0, SHARD_COMMITTEE_PERIOD=0,
-        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
-        ALTAIR_FORK_EPOCH=1, BELLATRIX_FORK_EPOCH=2**64 - 1,
-    )
+    cfg_altair = _CFG_ALTAIR
     fork_cases = collect_spec_test_cases("fork", "fork", config="minimal", fork="altair")
     if not fork_cases:
         pytest.skip("no fork vectors")
@@ -237,6 +236,47 @@ def test_fork_and_transition_vectors():
         assert _roots_equal(post, case, fork="altair"), f"transition {case.name}"
 
 
+_ALTAIR_EPOCH_HANDLERS = [
+    "justification_and_finalization",
+    "inactivity_updates",
+    "rewards_and_penalties",
+    "slashings",
+    "participation_flag_updates",
+    "sync_committee_updates",
+]
+
+
+@pytest.mark.parametrize("handler", _ALTAIR_EPOCH_HANDLERS)
+def test_epoch_processing_altair_vectors(handler):
+    from lodestar_tpu.config.chain_config import ChainConfig
+    from lodestar_tpu.state_transition.altair import (
+        process_inactivity_updates,
+        process_justification_and_finalization_altair,
+        process_participation_flag_updates,
+        process_rewards_and_penalties_altair,
+        process_slashings_altair,
+        process_sync_committee_updates,
+    )
+
+    cfg = _CFG_ALTAIR
+    fns = {
+        "justification_and_finalization": lambda st: process_justification_and_finalization_altair(MINIMAL, st),
+        "inactivity_updates": lambda st: process_inactivity_updates(MINIMAL, cfg, st),
+        "rewards_and_penalties": lambda st: process_rewards_and_penalties_altair(MINIMAL, cfg, st),
+        "slashings": lambda st: process_slashings_altair(MINIMAL, st),
+        "participation_flag_updates": lambda st: process_participation_flag_updates(st),
+        "sync_committee_updates": lambda st: process_sync_committee_updates(MINIMAL, st),
+    }
+    cases = collect_spec_test_cases("epoch_processing", handler, config="minimal", fork="altair")
+    if not cases:
+        pytest.skip(f"no altair epoch_processing/{handler} vectors")
+    for case_dir in cases:
+        case = load_spec_test_case(case_dir)
+        state = _state_of(case, "pre", fork="altair")
+        fns[handler](state)
+        assert _roots_equal(state, case, fork="altair"), f"altair {handler} {case.name}"
+
+
 def test_rewards_vectors():
     """rewards/basic: recompute the five delta components from pre and
     compare each pinned Deltas file (presets/rewards.ts)."""
@@ -251,10 +291,7 @@ def test_rewards_vectors():
     cases = collect_spec_test_cases("rewards", "basic", config="minimal", fork="phase0")
     if not cases:
         pytest.skip("no rewards vectors")
-    cfg = ChainConfig(
-        PRESET_BASE="minimal", MIN_GENESIS_TIME=0, SHARD_COMMITTEE_PERIOD=0,
-        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
-    )
+    cfg = _CFG
     dt = Container(
         "Deltas",
         [
@@ -356,11 +393,7 @@ def test_fork_choice_vectors():
     cases = collect_spec_test_cases("fork_choice", "on_block", config="minimal", fork="phase0")
     if not cases:
         pytest.skip("no fork_choice vectors")
-    cfg = ChainConfig(
-        PRESET_BASE="minimal", MIN_GENESIS_TIME=0, SHARD_COMMITTEE_PERIOD=0,
-        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
-        ALTAIR_FORK_EPOCH=2**64 - 1, BELLATRIX_FORK_EPOCH=2**64 - 1,
-    )
+    cfg = _CFG
     t = get_types(MINIMAL).phase0
 
     async def run_case(case):
@@ -406,7 +439,9 @@ def test_vector_coverage():
         ("fork_choice", "on_block", "phase0"),
         ("fork", "fork", "altair"),
         ("transition", "core", "altair"),
-    ] + [("epoch_processing", h, "phase0") for h in _EPOCH_HANDLERS]
+    ] + [("epoch_processing", h, "phase0") for h in _EPOCH_HANDLERS] + [
+        ("epoch_processing", h, "altair") for h in _ALTAIR_EPOCH_HANDLERS
+    ]
     missing = [
         f"{runner}/{handler}"
         for runner, handler, fork in wanted

@@ -324,6 +324,68 @@ def gen_ssz_static_and_shuffling(dev: DevChain) -> None:
         )
 
 
+def _altair_epoch_fns():
+    from lodestar_tpu.state_transition.altair import (
+        process_inactivity_updates,
+        process_justification_and_finalization_altair,
+        process_participation_flag_updates,
+        process_rewards_and_penalties_altair,
+        process_slashings_altair,
+        process_sync_committee_updates,
+    )
+
+    return {
+        "justification_and_finalization": lambda st: process_justification_and_finalization_altair(MINIMAL, st),
+        "inactivity_updates": lambda st: process_inactivity_updates(MINIMAL, CFG_ALTAIR, st),
+        "rewards_and_penalties": lambda st: process_rewards_and_penalties_altair(MINIMAL, CFG_ALTAIR, st),
+        "slashings": lambda st: process_slashings_altair(MINIMAL, st),
+        "participation_flag_updates": lambda st: process_participation_flag_updates(st),
+        "sync_committee_updates": lambda st: process_sync_committee_updates(MINIMAL, st),
+    }
+
+
+def gen_epoch_processing_altair(dev_altair: DevChain) -> None:
+    """Altair epoch_processing sub-cases (the altair-specific handlers:
+    inactivity/participation-flag/sync-committee updates).
+
+    The base state sits at the LAST slot before a sync-committee-period
+    boundary (next_epoch % EPOCHS_PER_SYNC_COMMITTEE_PERIOD == 0) so the
+    rotation actually fires, at an epoch >= 2 so altair justification
+    runs, and is perturbed with a slashed validator + nonzero inactivity
+    scores so those handlers do real work — an identity pre==post vector
+    pins nothing."""
+    base = clone_state(MINIMAL, dev_altair.chain.head_state())
+    period_epochs = MINIMAL.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    target_slot = period_epochs * MINIMAL.SLOTS_PER_EPOCH - 1
+    if base.slot < target_slot:
+        process_slots(MINIMAL, CFG_ALTAIR, base, target_slot)
+    # perturbations: a slashed validator mid-withdrawal window (altair
+    # slashings penalty applies at withdrawable - VECTOR/2) + inactivity
+    current_epoch = target_slot // MINIMAL.SLOTS_PER_EPOCH
+    v = base.validators[5]
+    v.slashed = True
+    # penalty applies when withdrawable == epoch + VECTOR/2 (spec
+    # process_slashings; the handler reads the epoch of state.slot)
+    v.withdrawable_epoch = current_epoch + MINIMAL.EPOCHS_PER_SLASHINGS_VECTOR // 2
+    base.slashings[current_epoch % MINIMAL.EPOCHS_PER_SLASHINGS_VECTOR] = (
+        v.effective_balance
+    )
+    scores = list(base.inactivity_scores)
+    scores[3] = 7
+    scores[7] = 12
+    base.inactivity_scores = scores
+    for handler, fn in _altair_epoch_fns().items():
+        pre = clone_state(MINIMAL, base)
+        post = clone_state(MINIMAL, pre)
+        fn(post)
+        assert state_bytes("altair", post) != state_bytes("altair", pre), (
+            f"identity altair epoch_processing vector pins nothing: {handler}"
+        )
+        d = case_dir("altair", "epoch_processing", handler, "pyspec_tests", "mid_chain")
+        write_ssz(d, "pre", state_bytes("altair", pre))
+        write_ssz(d, "post", state_bytes("altair", post))
+
+
 def _deltas_type():
     from lodestar_tpu.ssz import Container, List, uint64
 
@@ -497,8 +559,9 @@ async def main() -> None:
     gen_genesis()
     gen_merkle(dev)
     await gen_fork_choice()
-    dev_altair = await build_chain(CFG_ALTAIR, 2 * MINIMAL.SLOTS_PER_EPOCH + 1)
+    dev_altair = await build_chain(CFG_ALTAIR, MINIMAL.EPOCHS_PER_SYNC_COMMITTEE_PERIOD * MINIMAL.SLOTS_PER_EPOCH - 1)
     gen_transition(dev_altair)
+    gen_epoch_processing_altair(dev_altair)
     n = sum(len(files) for _, _, files in os.walk(ROOT))
     print(f"wrote {n} files under {os.path.abspath(ROOT)}")
 
