@@ -277,9 +277,10 @@ def test_epoch_processing_altair_vectors(handler):
         assert _roots_equal(state, case, fork="altair"), f"altair {handler} {case.name}"
 
 
-def test_rewards_vectors():
-    """rewards/basic: recompute the five delta components from pre and
-    compare each pinned Deltas file (presets/rewards.ts)."""
+@pytest.mark.parametrize("rhandler", ["basic", "leak"])
+def test_rewards_vectors(rhandler):
+    """rewards/{basic,leak}: recompute the five delta components from pre
+    and compare each pinned Deltas file (presets/rewards.ts)."""
     from lodestar_tpu.config.chain_config import ChainConfig
     from lodestar_tpu.ssz import Container, List, uint64
     from lodestar_tpu.state_transition import EpochContext
@@ -288,7 +289,7 @@ def test_rewards_vectors():
         get_attestation_component_deltas,
     )
 
-    cases = collect_spec_test_cases("rewards", "basic", config="minimal", fork="phase0")
+    cases = collect_spec_test_cases("rewards", rhandler, config="minimal", fork="phase0")
     if not cases:
         pytest.skip("no rewards vectors")
     cfg = _CFG
@@ -436,6 +437,7 @@ def test_vector_coverage():
         ("genesis", "validity", "phase0"),
         ("merkle", "single_proof", "phase0"),
         ("rewards", "basic", "phase0"),
+        ("rewards", "leak", "phase0"),
         ("fork_choice", "on_block", "phase0"),
         ("fork", "fork", "altair"),
         ("transition", "core", "altair"),

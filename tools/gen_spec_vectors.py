@@ -430,6 +430,28 @@ def gen_rewards(dev: DevChain) -> None:
                                 penalties=[int(x) for x in penalties])),
         )
 
+    # rewards/leak: finality stalled past MIN_EPOCHS_TO_INACTIVITY_PENALTY
+    # (blockless slots from genesis), exercising the is_inactivity_leak
+    # branch of every component
+    leak_pre = clone_state(MINIMAL, dev.chain.genesis_state)
+    leak_slot = (MINIMAL.MIN_EPOCHS_TO_INACTIVITY_PENALTY + 3) * MINIMAL.SLOTS_PER_EPOCH - 1
+    process_slots(MINIMAL, CFG, leak_pre, leak_slot)
+    lctx = EpochContext.create_from_state(MINIMAL, leak_pre)
+    lflags = before_process_epoch(MINIMAL, lctx, leak_pre)
+    lcomponents = get_attestation_component_deltas(MINIMAL, CFG, leak_pre, lflags)
+    # the inactivity component penalizes ONLY when is_inactivity_leak —
+    # the one signal that proves the leak branch actually fired
+    assert lcomponents["inactivity"][1].any(), "leak case must hit the leak branch"
+    d = case_dir("phase0", "rewards", "leak", "pyspec_tests", "stalled_finality")
+    write_ssz(d, "pre", state_bytes("phase0", leak_pre))
+    for key, stem in names.items():
+        rewards, penalties = lcomponents[key]
+        write_ssz(
+            d, stem,
+            dt.serialize(Fields(rewards=[int(x) for x in rewards],
+                                penalties=[int(x) for x in penalties])),
+        )
+
 
 def gen_genesis() -> None:
     """genesis/initialization + genesis/validity (official format:
