@@ -1,0 +1,50 @@
+"""CLI argument parsing + Engine-API jwt helpers.
+
+Reference: the reference validates fee-recipient/pubkey args at config
+time (cli/src/util/format) and mints HS256 jwts per request
+(eth1/provider/jwt.ts encodeJwtToken).
+"""
+
+import base64
+import hmac
+import json
+
+import pytest
+
+from lodestar_tpu.cli import _hex_bytes
+from lodestar_tpu.execution.engine import jwt_supplier_from_secret
+
+
+def test_hex_bytes_accepts_with_and_without_prefix():
+    want = bytes.fromhex("ab" * 20)
+    assert _hex_bytes("0x" + "ab" * 20, 20, "--x") == want
+    assert _hex_bytes("ab" * 20, 20, "--x") == want
+
+
+def test_hex_bytes_rejects_wrong_length_and_bad_hex():
+    # the silent-[2:]-slice bug class: an unprefixed value must NOT lose
+    # its first byte — it must fail loudly at config time
+    with pytest.raises(SystemExit, match="expected 20 bytes"):
+        _hex_bytes("ab" * 19, 20, "--x")
+    with pytest.raises(SystemExit, match="not valid hex"):
+        _hex_bytes("0xzz" + "ab" * 19, 20, "--x")
+
+
+def test_jwt_supplier_mints_valid_hs256_tokens():
+    secret = b"\x01" * 32
+    supply = jwt_supplier_from_secret(secret)
+    tok = supply()
+    h, p, sig = tok.split(".")
+    pad = lambda s: s + "=" * (-len(s) % 4)  # noqa: E731
+    header = json.loads(base64.urlsafe_b64decode(pad(h)))
+    payload = json.loads(base64.urlsafe_b64decode(pad(p)))
+    assert header == {"alg": "HS256", "typ": "JWT"}
+    assert isinstance(payload["iat"], int)
+    expect = (
+        base64.urlsafe_b64encode(
+            hmac.new(secret, f"{h}.{p}".encode(), "sha256").digest()
+        )
+        .rstrip(b"=")
+        .decode()
+    )
+    assert sig == expect
