@@ -66,8 +66,12 @@ class ForkChoice:
     # -- time ---------------------------------------------------------------
 
     def update_time(self, slot: int) -> None:
+        # boost lives for one slot: clear it only when the slot ADVANCES —
+        # spec on_tick resets proposer_boost_root at slot boundaries, so an
+        # intra-slot tick (e.g. the 1/3-slot attestation mark) must keep it
+        if slot > self.store.current_slot:
+            self.proposer_boost_root = None
         self.store.current_slot = slot
-        self.proposer_boost_root = None  # boost lives for one slot
 
     # -- block import --------------------------------------------------------
 

@@ -40,11 +40,17 @@ class ProtoNode:
 
 @dataclasses.dataclass
 class VoteTracker:
-    """One attester's latest vote (computeDeltas.ts VoteTracker)."""
+    """One attester's latest vote (computeDeltas.ts VoteTracker).
+
+    ``next_epoch`` starts at -1, NOT 0: the spec updates a latest message
+    whenever none exists yet, so a genesis-epoch attestation
+    (target_epoch == 0) must pass the ``target_epoch > next_epoch``
+    freshness check on a fresh tracker — with a 0 sentinel every epoch-0
+    vote was silently dropped from fork choice."""
 
     current_root: bytes = b"\x00" * 32
     next_root: bytes = b"\x00" * 32
-    next_epoch: int = 0
+    next_epoch: int = -1
 
 
 def compute_deltas(

@@ -380,20 +380,27 @@ def test_merkle_vectors():
         ), case.name
 
 
-def test_fork_choice_vectors():
-    """fork_choice/on_block step vectors (presets/fork_choice.ts): replay
-    anchor + ticks + blocks into a fresh chain, assert the head checks."""
+@pytest.mark.parametrize("fhandler", ["on_block", "on_attestation"])
+def test_fork_choice_vectors(fhandler):
+    """fork_choice step vectors (presets/fork_choice.ts): replay anchor +
+    ticks + blocks + attestations into a fresh chain, assert the head
+    checks.  Ticks drive fork-choice time (spec on_tick: boost expiry);
+    attestations resolve their committee and feed on_attestation."""
     import asyncio
 
     from lodestar_tpu.chain.beacon_chain import BeaconChain
     from lodestar_tpu.chain.bls_pool import BlsBatchPool
     from lodestar_tpu.chain.clock import ManualClock
-    from lodestar_tpu.config.chain_config import ChainConfig
     from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+    from lodestar_tpu.state_transition import (
+        EpochContext,
+        clone_state,
+        process_slots,
+    )
 
-    cases = collect_spec_test_cases("fork_choice", "on_block", config="minimal", fork="phase0")
+    cases = collect_spec_test_cases("fork_choice", fhandler, config="minimal", fork="phase0")
     if not cases:
-        pytest.skip("no fork_choice vectors")
+        pytest.skip(f"no fork_choice/{fhandler} vectors")
     cfg = _CFG
     t = get_types(MINIMAL).phase0
 
@@ -408,13 +415,37 @@ def test_fork_choice_vectors():
             if "tick" in step:
                 slot = (step["tick"] - int(anchor.genesis_time)) // cfg.SECONDS_PER_SLOT
                 clock.set_slot(slot)
+                chain.fork_choice.update_time(slot)
             elif "block" in step:
                 signed = t.SignedBeaconBlock.deserialize(case.files[step["block"]])
                 await chain.process_block(signed)
+            elif "attestation" in step:
+                att = t.Attestation.deserialize(case.files[step["attestation"]])
+                # committee from the ATTESTED fork's state (spec
+                # on_attestation resolves via the target-checkpoint state,
+                # not the current head — shufflings diverge across forks)
+                fork_state = chain.get_state_by_block_root(
+                    bytes(att.data.beacon_block_root)
+                ) or chain.head_state()
+                st = clone_state(MINIMAL, fork_state)
+                ctx = (
+                    process_slots(MINIMAL, cfg, st, att.data.slot)
+                    if st.slot < att.data.slot
+                    else EpochContext.create_from_state(MINIMAL, st)
+                )
+                indices = ctx.get_attesting_indices(att.data, att.aggregation_bits)
+                if chain.fork_choice.has_block(bytes(att.data.beacon_block_root)):
+                    chain.fork_choice.on_attestation(
+                        indices,
+                        bytes(att.data.beacon_block_root),
+                        att.data.target.epoch,
+                    )
             elif "checks" in step:
+                head_root = chain.fork_choice.update_head()
                 head = step["checks"]["head"]
-                assert chain.head_root.hex() == head["root"][2:], case.name
-                assert int(chain.head_state().slot) == head["slot"], case.name
+                assert head_root.hex() == head["root"][2:], case.name
+                node = chain.fork_choice.get_block(head_root)
+                assert int(node.slot) == head["slot"], case.name
         pool.close()
 
     for case_dir in cases:
@@ -439,6 +470,7 @@ def test_vector_coverage():
         ("rewards", "basic", "phase0"),
         ("rewards", "leak", "phase0"),
         ("fork_choice", "on_block", "phase0"),
+        ("fork_choice", "on_attestation", "phase0"),
         ("fork", "fork", "altair"),
         ("transition", "core", "altair"),
     ] + [("epoch_processing", h, "phase0") for h in _EPOCH_HANDLERS] + [

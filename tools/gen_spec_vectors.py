@@ -568,6 +568,46 @@ async def gen_fork_choice() -> None:
     write_yaml(d, "steps", steps)
 
 
+async def gen_fork_choice_on_attestation() -> None:
+    """fork_choice/on_attestation: two competing one-block forks off
+    genesis; LMD votes must flip the head to the attested fork once the
+    proposer boost of the later block expires."""
+    a = await build_chain(CFG, 0)
+    b = await build_chain(CFG, 0)
+    blk_a = await a.produce_and_import_block(1)   # A: block at slot 1
+    a.attest(1)                                   # votes for A's block
+    blk_b = await b.produce_and_import_block(2)   # B: slot 2 off genesis
+
+    d = case_dir(
+        "phase0", "fork_choice", "on_attestation", "pyspec_tests", "votes_flip_head"
+    )
+    anchor = a.chain.genesis_state
+    write_ssz(d, "anchor_state", state_bytes("phase0", anchor))
+    anchor_block = Fields(
+        slot=0, proposer_index=0, parent_root=b"\x00" * 32,
+        state_root=T.phase0.BeaconState.hash_tree_root(anchor),
+        body=T.phase0.BeaconBlockBody.default(),
+    )
+    write_ssz(d, "anchor_block", T.phase0.BeaconBlock.serialize(anchor_block))
+    genesis_time = int(anchor.genesis_time)
+    steps = []
+    for i, blk in enumerate((blk_a, blk_b)):
+        write_ssz(d, f"block_{i}", block_bytes("phase0", blk))
+        steps.append({"tick": genesis_time + int(blk.message.slot) * CFG.SECONDS_PER_SLOT})
+        steps.append({"block": f"block_{i}"})
+    # B's proposer boost makes it head at slot 2...
+    root_b = T.phase0.BeaconBlock.hash_tree_root(blk_b.message)
+    steps.append({"checks": {"head": {"slot": 2, "root": "0x" + bytes(root_b).hex()}}})
+    # ...then slot advances (boost expires) and A's votes land
+    steps.append({"tick": genesis_time + 3 * CFG.SECONDS_PER_SLOT})
+    for i, att in enumerate(a.pending_attestations):
+        write_ssz(d, f"attestation_{i}", T.phase0.Attestation.serialize(att))
+        steps.append({"attestation": f"attestation_{i}"})
+    root_a = T.phase0.BeaconBlock.hash_tree_root(blk_a.message)
+    steps.append({"checks": {"head": {"slot": 1, "root": "0x" + bytes(root_a).hex()}}})
+    write_yaml(d, "steps", steps)
+
+
 async def main() -> None:
     if os.path.isdir(ROOT):
         shutil.rmtree(ROOT)
@@ -581,6 +621,7 @@ async def main() -> None:
     gen_genesis()
     gen_merkle(dev)
     await gen_fork_choice()
+    await gen_fork_choice_on_attestation()
     dev_altair = await build_chain(CFG_ALTAIR, MINIMAL.EPOCHS_PER_SYNC_COMMITTEE_PERIOD * MINIMAL.SLOTS_PER_EPOCH - 1)
     gen_transition(dev_altair)
     gen_epoch_processing_altair(dev_altair)
