@@ -22,6 +22,13 @@ Design rules (all empirically pinned by the round-4 probes):
 Correctness: differential-tested against the bigint oracle and
 ops/tower.py in tests/test_pallas_tower.py — in interpret mode on CPU
 (every CI run) and compiled on TPU when one is present.
+
+Known round-5 optimization (deliberately NOT taken yet): every in-kernel
+add/sub currently runs a full _fold50 reduction; the digit budget allows
+deferring strictification through the fq6 recombination (an unreduced
+<=512-digit sum still fits k_fp_sub's 2^12 pad), saving ~8 fold ladders
+per Fq6 product.  Do it with the round-5 measurement loop in place —
+every relaxation needs its bound re-derived.
 """
 
 from __future__ import annotations
@@ -102,6 +109,33 @@ def k_fp_sub(a: jnp.ndarray, b: jnp.ndarray, red: jnp.ndarray, pad: jnp.ndarray)
     return _fold50(a + (pad[None, :] - b), red, 13)  # nonnegative, < 2^13
 
 
+# -- in-kernel Fq2 algebra on component pairs ((B,50), (B,50)) --------------
+
+
+def k_fq2_mul(a, b, red, pad):
+    """Karatsuba Fq2 product on component tuples."""
+    t0 = k_fp_mul(a[0], b[0], red)
+    t1 = k_fp_mul(a[1], b[1], red)
+    t2 = k_fp_mul(k_fp_add(a[0], a[1], red), k_fp_add(b[0], b[1], red), red)
+    return (
+        k_fp_sub(t0, t1, red, pad),
+        k_fp_sub(t2, k_fp_add(t0, t1, red), red, pad),
+    )
+
+
+def k_fq2_add(a, b, red):
+    return (k_fp_add(a[0], b[0], red), k_fp_add(a[1], b[1], red))
+
+
+def k_fq2_sub(a, b, red, pad):
+    return (k_fp_sub(a[0], b[0], red, pad), k_fp_sub(a[1], b[1], red, pad))
+
+
+def k_fq2_mul_by_xi(a, red, pad):
+    """(1+u)(c0 + c1 u) = (c0 - c1) + (c0 + c1) u."""
+    return (k_fp_sub(a[0], a[1], red, pad), k_fp_add(a[0], a[1], red))
+
+
 # -- fused Fq2 kernels ------------------------------------------------------
 
 
@@ -109,13 +143,11 @@ def _fq2_mul_kernel(a_ref, b_ref, red_ref, pad_ref, o_ref):
     """Karatsuba: (t0 - t1) + ((a0+a1)(b0+b1) - t0 - t1) u."""
     red = red_ref[...]
     pad = pad_ref[...]
-    a0, a1 = a_ref[:, 0, :], a_ref[:, 1, :]
-    b0, b1 = b_ref[:, 0, :], b_ref[:, 1, :]
-    t0 = k_fp_mul(a0, b0, red)
-    t1 = k_fp_mul(a1, b1, red)
-    t2 = k_fp_mul(k_fp_add(a0, a1, red), k_fp_add(b0, b1, red), red)
-    o_ref[:, 0, :] = k_fp_sub(t0, t1, red, pad)
-    o_ref[:, 1, :] = k_fp_sub(t2, k_fp_add(t0, t1, red), red, pad)
+    c = k_fq2_mul(
+        (a_ref[:, 0, :], a_ref[:, 1, :]), (b_ref[:, 0, :], b_ref[:, 1, :]), red, pad
+    )
+    o_ref[:, 0, :] = c[0]
+    o_ref[:, 1, :] = c[1]
 
 
 def _fq2_sqr_kernel(a_ref, red_ref, pad_ref, o_ref):
@@ -127,6 +159,42 @@ def _fq2_sqr_kernel(a_ref, red_ref, pad_ref, o_ref):
     m = k_fp_mul(a0, a1, red)
     o_ref[:, 0, :] = c0
     o_ref[:, 1, :] = k_fp_add(m, m, red)
+
+
+def _fq6_mul_kernel(a_ref, b_ref, red_ref, pad_ref, o_ref):
+    """Toom-style Fq6 product (tower._fq6_mul_lanes/_fq6_recombine, the
+    oracle Fq6.__mul__ scheme) fully fused: 6 Fq2 lane products + the
+    xi recombination in ONE kernel."""
+    red = red_ref[...]
+    pad = pad_ref[...]
+    A = [(a_ref[:, j, 0, :], a_ref[:, j, 1, :]) for j in range(3)]
+    B_ = [(b_ref[:, j, 0, :], b_ref[:, j, 1, :]) for j in range(3)]
+    t0 = k_fq2_mul(A[0], B_[0], red, pad)
+    t1 = k_fq2_mul(A[1], B_[1], red, pad)
+    t2 = k_fq2_mul(A[2], B_[2], red, pad)
+    t3 = k_fq2_mul(k_fq2_add(A[1], A[2], red), k_fq2_add(B_[1], B_[2], red), red, pad)
+    t4 = k_fq2_mul(k_fq2_add(A[0], A[1], red), k_fq2_add(B_[0], B_[1], red), red, pad)
+    t5 = k_fq2_mul(k_fq2_add(A[0], A[2], red), k_fq2_add(B_[0], B_[2], red), red, pad)
+    c0 = k_fq2_add(
+        t0, k_fq2_mul_by_xi(k_fq2_sub(t3, k_fq2_add(t1, t2, red), red, pad), red, pad), red
+    )
+    c1 = k_fq2_add(
+        k_fq2_sub(t4, k_fq2_add(t0, t1, red), red, pad), k_fq2_mul_by_xi(t2, red, pad), red
+    )
+    c2 = k_fq2_add(k_fq2_sub(t5, k_fq2_add(t0, t2, red), red, pad), t1, red)
+    for j, c in enumerate((c0, c1, c2)):
+        o_ref[:, j, 0, :] = c[0]
+        o_ref[:, j, 1, :] = c[1]
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def fq6_mul(a: jnp.ndarray, b: jnp.ndarray, *, interpret: bool = False) -> jnp.ndarray:
+    """One fused Fq6 product: a, b (B, 3, 2, 50) semi-strict."""
+    return pl.pallas_call(
+        _fq6_mul_kernel,
+        out_shape=jax.ShapeDtypeStruct((a.shape[0], 3, 2, NL), jnp.float32),
+        interpret=interpret,
+    )(a, b, jnp.asarray(RED), jnp.asarray(SUBPAD))
 
 
 @partial(jax.jit, static_argnames=("interpret",))
