@@ -29,6 +29,16 @@ deferring strictification through the fq6 recombination (an unreduced
 <=512-digit sum still fits k_fp_sub's 2^12 pad), saving ~8 fold ladders
 per Fq6 product.  Do it with the round-5 measurement loop in place —
 every relaxation needs its bound re-derived.
+
+Kernel-size ceiling (measured): Mosaic compiles fq2 kernels in ~15s and
+the fq6 kernel (18 schoolbook muls) in ~200s, but the MONOLITHIC fq12
+kernel (54 muls) did not finish compiling in 40+ minutes through the
+axon tunnel.  `fq12_mul` below is therefore correctness-verified in
+interpret mode but should be treated as a reference shape only: the
+production fq12 path should COMPOSE the fq6 kernel (3 fq6-kernel calls
++ cheap recombination) — per-op overhead at the fq6 level is already
+single-digit microseconds, so composition costs ~3 kernel hops, not
+hundreds of HLO ops.
 """
 
 from __future__ import annotations
@@ -161,14 +171,9 @@ def _fq2_sqr_kernel(a_ref, red_ref, pad_ref, o_ref):
     o_ref[:, 1, :] = k_fp_add(m, m, red)
 
 
-def _fq6_mul_kernel(a_ref, b_ref, red_ref, pad_ref, o_ref):
-    """Toom-style Fq6 product (tower._fq6_mul_lanes/_fq6_recombine, the
-    oracle Fq6.__mul__ scheme) fully fused: 6 Fq2 lane products + the
-    xi recombination in ONE kernel."""
-    red = red_ref[...]
-    pad = pad_ref[...]
-    A = [(a_ref[:, j, 0, :], a_ref[:, j, 1, :]) for j in range(3)]
-    B_ = [(b_ref[:, j, 0, :], b_ref[:, j, 1, :]) for j in range(3)]
+def k_fq6_mul(A, B_, red, pad):
+    """Toom-style Fq6 product on 3-component lists of Fq2 tuples
+    (tower._fq6_mul_lanes/_fq6_recombine; oracle Fq6.__mul__)."""
     t0 = k_fq2_mul(A[0], B_[0], red, pad)
     t1 = k_fq2_mul(A[1], B_[1], red, pad)
     t2 = k_fq2_mul(A[2], B_[2], red, pad)
@@ -182,9 +187,62 @@ def _fq6_mul_kernel(a_ref, b_ref, red_ref, pad_ref, o_ref):
         k_fq2_sub(t4, k_fq2_add(t0, t1, red), red, pad), k_fq2_mul_by_xi(t2, red, pad), red
     )
     c2 = k_fq2_add(k_fq2_sub(t5, k_fq2_add(t0, t2, red), red, pad), t1, red)
-    for j, c in enumerate((c0, c1, c2)):
+    return [c0, c1, c2]
+
+
+def k_fq6_add(A, B_, red):
+    return [k_fq2_add(A[j], B_[j], red) for j in range(3)]
+
+
+def k_fq6_sub(A, B_, red, pad):
+    return [k_fq2_sub(A[j], B_[j], red, pad) for j in range(3)]
+
+
+def k_fq6_mul_by_v(A, red, pad):
+    """v * (c0, c1, c2) = (xi*c2, c0, c1)."""
+    return [k_fq2_mul_by_xi(A[2], red, pad), A[0], A[1]]
+
+
+def _fq6_mul_kernel(a_ref, b_ref, red_ref, pad_ref, o_ref):
+    """One fused Fq6 product: 6 Fq2 lane karatsubas + xi recombination."""
+    red = red_ref[...]
+    pad = pad_ref[...]
+    A = [(a_ref[:, j, 0, :], a_ref[:, j, 1, :]) for j in range(3)]
+    B_ = [(b_ref[:, j, 0, :], b_ref[:, j, 1, :]) for j in range(3)]
+    for j, c in enumerate(k_fq6_mul(A, B_, red, pad)):
         o_ref[:, j, 0, :] = c[0]
         o_ref[:, j, 1, :] = c[1]
+
+
+def _fq12_mul_kernel(a_ref, b_ref, red_ref, pad_ref, o_ref):
+    """One fused Fq12 product: karatsuba over Fq6 (tower.fq12_mul —
+    c0 = T0 + v*T1, c1 = (a0+a1)(b0+b1) - T0 - T1) — 54 base-field
+    schoolbook multiplies in a single Mosaic kernel."""
+    red = red_ref[...]
+    pad = pad_ref[...]
+    A = [(a_ref[:, j, 0, :], a_ref[:, j, 1, :]) for j in range(6)]
+    B_ = [(b_ref[:, j, 0, :], b_ref[:, j, 1, :]) for j in range(6)]
+    a0, a1 = A[0:3], A[3:6]
+    b0, b1 = B_[0:3], B_[3:6]
+    T0 = k_fq6_mul(a0, b0, red, pad)
+    T1 = k_fq6_mul(a1, b1, red, pad)
+    T3 = k_fq6_mul(k_fq6_add(a0, a1, red), k_fq6_add(b0, b1, red), red, pad)
+    C0 = k_fq6_add(T0, k_fq6_mul_by_v(T1, red, pad), red)
+    C1 = k_fq6_sub(T3, k_fq6_add(T0, T1, red), red, pad)
+    for j, c in enumerate(C0 + C1):
+        o_ref[:, j, 0, :] = c[0]
+        o_ref[:, j, 1, :] = c[1]
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def fq12_mul(a: jnp.ndarray, b: jnp.ndarray, *, interpret: bool = False) -> jnp.ndarray:
+    """One fused Fq12 product: a, b (B, 6, 2, 50) semi-strict, flat
+    component order [c00, c01, c02, c10, c11, c12] (ops/tower.py)."""
+    return pl.pallas_call(
+        _fq12_mul_kernel,
+        out_shape=jax.ShapeDtypeStruct((a.shape[0], 6, 2, NL), jnp.float32),
+        interpret=interpret,
+    )(a, b, jnp.asarray(RED), jnp.asarray(SUBPAD))
 
 
 @partial(jax.jit, static_argnames=("interpret",))
