@@ -1,0 +1,451 @@
+"""Fused-dispatch core: loose-input Pallas TPU kernels + static bound tracking.
+
+This is the round-5 production substrate for the batched BLS dispatch (the
+TPU replacement for blst's pairing core behind the reference's worker pool,
+packages/beacon-node/src/chain/bls/multithread/worker.ts).  The round-4
+probes established the cost model this module is built around:
+
+- The XLA-graph field ops pay ~1 us of per-HLO-op dispatch overhead; one
+  library fq2_mul (~350 tiny HLO ops) costs ~395 us on the serial path.
+- The SAME op hand-fused into one Pallas kernel runs at the measurement
+  floor (<~1 us compute, ~10 us per serial kernel call including launch).
+- Mosaic's practical kernel-size ceiling is ~18 schoolbook multiplies
+  (fq6-sized, ~200 s compile); a 54-multiply kernel never finished.
+
+Architecture that follows from those numbers:
+
+1. A SMALL set of generic kernels, each under the Mosaic ceiling, each
+   accepting LOOSE digit inputs (any digit <= 2^22) and normalizing on
+   entry IN-KERNEL.  Glue between kernels is then single XLA adds and
+   pad-subtracts (1 HLO op each) instead of 50-op fold ladders.
+2. Lane stacking: every multi-multiplication (Karatsuba branches, point
+   formulas) flattens its independent products onto the kernel's batch
+   axis — call count, not lane count, is what costs.
+3. Uniform BLK-row grid blocks: one Mosaic compile per kernel, reused at
+   every batch size (batches are padded up to a block multiple).
+4. Static bound tracking (LV): every loose value carries its compile-time
+   digit bound; subtraction pads are sized from the tracked bound and
+   f32-exactness (< 2^22 into any kernel) is ASSERTED at trace time, not
+   hand-audited.
+
+Digit representation, constants, and the in-kernel helper set are shared
+with ops/limbs.py / ops/pallas_tower.py (8-bit f32 digits, 50 limbs, RED
+fold table, two's-complement subtraction pads) — every invariant pinned by
+the round-3/4 miscompile hunts carries over unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..crypto.bls.fields import P as P_INT
+from . import limbs as fl
+from .pallas_tower import (
+    NL,
+    RED,
+    SUBPAD,
+    _fold50,
+    k_fp_add,
+    k_fp_mul,
+    k_fp_sub,
+    k_fq2_add,
+    k_fq2_mul,
+    k_fq2_mul_by_xi,
+    k_fq2_sub,
+)
+
+# ---------------------------------------------------------------------------
+# configuration
+# ---------------------------------------------------------------------------
+
+BLK = 256  # grid block rows: one Mosaic compile per kernel, any batch size
+
+# Hard ceiling for digits entering any kernel: the entry normalization
+# (_fold50 at bound 22) is f32-exact only below 2^22.
+MAX_BOUND = (1 << 22) - 1
+
+
+def default_interpret() -> bool:
+    """Pallas interpret mode off only on real TPU backends."""
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# subtraction pads, tiered by subtrahend bound
+# ---------------------------------------------------------------------------
+
+_PAD_CACHE: dict = {}
+
+
+def _pad_for(bound: int) -> np.ndarray:
+    """50-digit pad whose value is a multiple of p and whose digits all lie
+    in [bias, bias + 2^8) for the smallest power-of-two bias >= bound.
+    ``a + pad - b`` is then digit-wise non-negative for any b with digits
+    <= bound (the limbs._sub_pad scheme, generalized to tiered biases)."""
+    bias_bits = max(9, int(bound - 1).bit_length())
+    if bias_bits not in _PAD_CACHE:
+        bias = 1 << bias_bits
+        base = sum(bias << (fl.LIMB_BITS * i) for i in range(NL))
+        k = -(-base // P_INT)
+        diff = k * P_INT - base  # in [0, p)
+        _PAD_CACHE[bias_bits] = fl.int_to_limbs(diff, NL) + fl.NP_DTYPE(bias)
+    return _PAD_CACHE[bias_bits]
+
+
+def _pad_max(bound: int) -> int:
+    bias = 1 << max(9, int(bound - 1).bit_length())
+    return bias + 255
+
+
+# ---------------------------------------------------------------------------
+# LV: a loose field value with its static digit bound
+# ---------------------------------------------------------------------------
+
+
+class LV(NamedTuple):
+    """A digit array (..., 50) — possibly with extra component axes before
+    the digit axis — plus the compile-time bound on any digit's value."""
+
+    a: jnp.ndarray
+    b: int
+
+    def check(self) -> "LV":
+        if self.b > MAX_BOUND:
+            raise ValueError(f"loose digit bound {self.b} exceeds f32-exact cap")
+        return self
+
+
+def lv(a: jnp.ndarray, bound: int = 256) -> LV:
+    return LV(a, bound)
+
+
+def lcast(x: LV, bound: int) -> LV:
+    """Raise (never lower) the tracked bound — for scan-carry stability."""
+    if bound < x.b:
+        raise ValueError(f"cannot tighten bound {x.b} -> {bound}")
+    return LV(x.a, bound)
+
+
+def ladd(x: LV, y: LV) -> LV:
+    return LV(x.a + y.a, x.b + y.b).check()
+
+
+def ldbl(x: LV) -> LV:
+    return LV(x.a + x.a, 2 * x.b).check()
+
+
+def lsub(x: LV, y: LV) -> LV:
+    """x - y mod p, loose: x + (pad - y) with the pad tier sized from y's
+    tracked bound.  No carries, no negative digits."""
+    pad = jnp.asarray(_pad_for(y.b))
+    return LV(x.a + (pad - y.a), x.b + _pad_max(y.b)).check()
+
+
+def lneg(x: LV) -> LV:
+    pad = jnp.asarray(_pad_for(x.b))
+    return LV(pad - x.a, _pad_max(x.b)).check()
+
+
+def lselect(cond: jnp.ndarray, x: LV, y: LV) -> LV:
+    """where(cond, x, y); cond broadcasts over the trailing value axes."""
+    extra = x.a.ndim - cond.ndim
+    c = cond.reshape(cond.shape + (1,) * extra)
+    return LV(jnp.where(c, x.a, y.a), max(x.b, y.b))
+
+
+def lstack(vals, axis: int) -> LV:
+    return LV(jnp.stack([v.a for v in vals], axis=axis), max(v.b for v in vals))
+
+
+def lconcat(vals, axis: int) -> LV:
+    return LV(jnp.concatenate([v.a for v in vals], axis=axis), max(v.b for v in vals))
+
+
+# Fq2 component access on (..., 2, 50) LVs
+def lc(x: LV, i: int, axis: int = -2) -> LV:
+    return LV(jnp.take(x.a, i, axis=axis), x.b)
+
+
+# ---------------------------------------------------------------------------
+# kernel bodies (operate on (BLK, ...) refs; all inputs loose <= 2^22)
+# ---------------------------------------------------------------------------
+
+
+def _norm(x: jnp.ndarray, red: jnp.ndarray) -> jnp.ndarray:
+    """In-kernel entry normalization: loose (B, 50) -> semi-strict."""
+    return _fold50(x, red, 22)
+
+
+def _mul_k(a_ref, b_ref, red_ref, o_ref):
+    red = red_ref[...]
+    o_ref[...] = k_fp_mul(_norm(a_ref[...], red), _norm(b_ref[...], red), red)
+
+
+def _fq2mul_k(a_ref, b_ref, red_ref, pad_ref, o_ref):
+    red, pad = red_ref[...], pad_ref[...]
+    a = (_norm(a_ref[:, 0, :], red), _norm(a_ref[:, 1, :], red))
+    b = (_norm(b_ref[:, 0, :], red), _norm(b_ref[:, 1, :], red))
+    c = k_fq2_mul(a, b, red, pad)
+    o_ref[:, 0, :] = c[0]
+    o_ref[:, 1, :] = c[1]
+
+
+def _fq2sqr_k(a_ref, red_ref, pad_ref, o_ref, f_ref):
+    """Fused Fq2 square; ALSO returns the normalized input (free — it is
+    computed anyway), which callers use to keep glue bounds small (e.g. the
+    cyclotomic-square recombination needs folded copies of its inputs)."""
+    red, pad = red_ref[...], pad_ref[...]
+    a0, a1 = _norm(a_ref[:, 0, :], red), _norm(a_ref[:, 1, :], red)
+    c0 = k_fp_mul(k_fp_add(a0, a1, red), k_fp_sub(a0, a1, red, pad), red)
+    m = k_fp_mul(a0, a1, red)
+    o_ref[:, 0, :] = c0
+    o_ref[:, 1, :] = k_fp_add(m, m, red)
+    f_ref[:, 0, :] = a0
+    f_ref[:, 1, :] = a1
+
+
+def _pow16mul_k(r_ref, t_ref, red_ref, o_ref):
+    """o = r^16 * t in Fq — the body of every 4-bit-windowed pow scan
+    (Fermat inversion, Legendre chi).  5 schoolbook multiplies, one kernel."""
+    red = red_ref[...]
+    r = _norm(r_ref[...], red)
+    t = _norm(t_ref[...], red)
+    for _ in range(4):
+        r = k_fp_mul(r, r, red)
+    o_ref[...] = k_fp_mul(r, t, red)
+
+
+def _fq2pow16mul_k(r_ref, t_ref, red_ref, pad_ref, o_ref):
+    """o = r^16 * t in Fq2 (4 fused squarings + one Karatsuba = 11
+    schoolbook multiplies — under the Mosaic ceiling)."""
+    red, pad = red_ref[...], pad_ref[...]
+    r = (_norm(r_ref[:, 0, :], red), _norm(r_ref[:, 1, :], red))
+    t = (_norm(t_ref[:, 0, :], red), _norm(t_ref[:, 1, :], red))
+    for _ in range(4):
+        c0 = k_fp_mul(k_fp_add(r[0], r[1], red), k_fp_sub(r[0], r[1], red, pad), red)
+        m = k_fp_mul(r[0], r[1], red)
+        r = (c0, k_fp_add(m, m, red))
+    c = k_fq2_mul(r, t, red, pad)
+    o_ref[:, 0, :] = c[0]
+    o_ref[:, 1, :] = c[1]
+
+
+def _fold_k(x_ref, red_ref, o_ref):
+    o_ref[...] = _norm(x_ref[...], red_ref[...])
+
+
+# -- canonical reduction (Barrett) ------------------------------------------
+
+_MU6 = fl.int_to_limbs((1 << 424) // P_INT, 6)
+_P48 = fl.int_to_limbs(P_INT, 48)
+_PC = fl.int_to_limbs(P_INT, NL)
+_P2C = fl.int_to_limbs(2 * P_INT, NL)
+_HOT0_51 = np.zeros(51, dtype=fl.NP_DTYPE)
+_HOT0_51[0] = 1.0
+
+
+def _k_ripple(x: jnp.ndarray, w: int) -> jnp.ndarray:
+    """Exact serial carry ripple, statically unrolled (Mosaic-safe: static
+    slices, pad+add accumulation — no scatter, no dynamic slicing).
+    x: (B, W<=w) semi-strict-ish digits; returns (B, w) fully-strict."""
+    carry = jnp.zeros((x.shape[0], 1), jnp.float32)
+    out = jnp.zeros((x.shape[0], w), jnp.float32)
+    for i in range(w):
+        t = carry if i >= x.shape[1] else x[:, i : i + 1] + carry
+        hi = jnp.floor(t * np.float32(1.0 / 256.0))
+        out = out + jnp.pad(t - hi * np.float32(256.0), ((0, 0), (i, w - 1 - i)))
+        carry = hi
+    return out
+
+
+def _k_cond_sub(r: jnp.ndarray, c: jnp.ndarray, hot0: jnp.ndarray) -> jnp.ndarray:
+    """r - c if r >= c else r, for fully-strict (B, 50) r and a passed
+    50-digit constant c (limbs._cond_sub, re-expressed without scatter)."""
+    t = r + (np.float32(255.0) - c) + hot0[:NL]
+    s = _k_ripple(t, NL + 1)
+    ge = s[:, NL : NL + 1] == 1.0
+    return jnp.where(ge, s[:, :NL], r)
+
+
+def _canon_k(x_ref, red_ref, mu_ref, p48_ref, pc_ref, p2c_ref, hot_ref, o_ref):
+    """Loose (B, 50) -> canonical residue < p (fully strict digits).
+
+    In-kernel port of limbs.fp_reduce_full: fold, exact ripple, Barrett
+    quotient via mu = floor(2^424/p), two conditional subtracts.  Replaces
+    the three serial lax.scan ripples that sat inside every complete-add
+    ladder iteration of the XLA path."""
+    mu, hot0 = mu_ref[...], hot_ref[...]
+    x = _k_ripple(_norm(x_ref[...], red_ref[...]), NL + 1)  # strict, 51 digits
+    t = x[:, 47:51]
+    z = jnp.zeros((x.shape[0], 11), jnp.float32)
+    for i in range(4):
+        z = z + jnp.pad(t[:, i : i + 1] * mu, ((0, 0), (i, 11 - 6 - i)))
+    z = _k_ripple(z, 12)
+    qhat = z[:, 6:9]
+    qp = jnp.zeros((x.shape[0], NL + 1), jnp.float32)
+    for i in range(3):
+        qp = qp + jnp.pad(
+            qhat[:, i : i + 1] * p48_ref[...], ((0, 0), (i, NL + 1 - 48 - i))
+        )
+    qp = _k_ripple(qp, NL + 1)
+    # r = x - qp (known non-negative): two's complement, discard borrow digit
+    diff = x + (np.float32(255.0) - qp) + hot0
+    r = _k_ripple(diff, NL + 1)[:, :NL]
+    r = _k_cond_sub(r, p2c_ref[...], hot0)
+    o_ref[...] = _k_cond_sub(r, pc_ref[...], hot0)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call wrappers: flatten leading axes, pad to BLK, grid over rows
+# ---------------------------------------------------------------------------
+
+
+# constant operand sets, materialized once (constant-stability rule)
+_CONSTS_RED = (RED,)
+_CONSTS_RED_PAD = (RED, SUBPAD)
+_CONSTS_CANON = (RED, _MU6, _P48, _PC, _P2C, _HOT0_51)
+
+
+def _pcall(kernel, args, consts, out_tail_shapes, interpret):
+    """Run ``kernel`` over row blocks.
+
+    args: data arrays with identical leading row count N; consts: numpy
+    constant arrays handed to every program whole (kernel constants must be
+    operands, never closure captures — the round-4 rule).  Rows are
+    independent, so N is padded up to a BLK multiple and the grid iterates
+    row blocks — one Mosaic compile per kernel, any N.
+    """
+    n = args[0].shape[0]
+    npad = -(-n // BLK) * BLK
+    padded = [
+        jnp.pad(a, [(0, npad - n)] + [(0, 0)] * (a.ndim - 1)) if npad != n else a
+        for a in args
+    ]
+    grid = (npad // BLK,)
+
+    def spec(tail):
+        nd = len(tail)
+        return pl.BlockSpec((BLK,) + tail, lambda i, _nd=nd: (i,) + (0,) * _nd)
+
+    def const_spec(shape):
+        nd = len(shape)
+        return pl.BlockSpec(shape, lambda i, _nd=nd: (0,) * _nd)
+
+    out_shape = tuple(
+        jax.ShapeDtypeStruct((npad,) + tail, jnp.float32) for tail in out_tail_shapes
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[spec(a.shape[1:]) for a in padded]
+        + [const_spec(c.shape) for c in consts],
+        out_specs=tuple(spec(t) for t in out_tail_shapes),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*padded, *[jnp.asarray(c) for c in consts])
+    if npad != n:
+        outs = tuple(o[:n] for o in outs)
+    return outs
+
+
+def _flatten_to(a: jnp.ndarray, tail_ndim: int):
+    """(..., *tail) -> ((N, *tail), restore_fn)."""
+    lead = a.shape[: a.ndim - tail_ndim]
+    tail = a.shape[a.ndim - tail_ndim :]
+    flat = a.reshape((-1,) + tail)
+    return flat, lead
+
+
+# ---------------------------------------------------------------------------
+# public fused ops (LV in, LV out; semi-strict outputs)
+# ---------------------------------------------------------------------------
+
+
+def f_mul(x: LV, y: LV, interpret: bool | None = None) -> LV:
+    """Fq product on (..., 50) loose LVs — one fused kernel call."""
+    if interpret is None:
+        interpret = default_interpret()
+    x.check(), y.check()
+    xa, lead = _flatten_to(x.a, 1)
+    ya, _ = _flatten_to(jnp.broadcast_to(y.a, x.a.shape), 1)
+    (o,) = _pcall(_mul_k, [xa, ya], _CONSTS_RED, [(NL,)], interpret)
+    return lv(o.reshape(lead + (NL,)))
+
+
+def f2_mul(x: LV, y: LV, interpret: bool | None = None) -> LV:
+    """Fq2 product on (..., 2, 50) loose LVs — one fused Karatsuba kernel."""
+    if interpret is None:
+        interpret = default_interpret()
+    x.check(), y.check()
+    shape = jnp.broadcast_shapes(x.a.shape, y.a.shape)
+    xa, lead = _flatten_to(jnp.broadcast_to(x.a, shape), 2)
+    ya, _ = _flatten_to(jnp.broadcast_to(y.a, shape), 2)
+    (o,) = _pcall(_fq2mul_k, [xa, ya], _CONSTS_RED_PAD, [(2, NL)], interpret)
+    return lv(o.reshape(lead + (2, NL)))
+
+
+def f2_sqr(x: LV, interpret: bool | None = None) -> tuple[LV, LV]:
+    """Fq2 square; returns (square, normalized-input)."""
+    if interpret is None:
+        interpret = default_interpret()
+    x.check()
+    xa, lead = _flatten_to(x.a, 2)
+    o, f = _pcall(_fq2sqr_k, [xa], _CONSTS_RED_PAD, [(2, NL), (2, NL)], interpret)
+    return lv(o.reshape(lead + (2, NL))), lv(f.reshape(lead + (2, NL)))
+
+
+def f_pow16mul(r: LV, t: LV, interpret: bool | None = None) -> LV:
+    if interpret is None:
+        interpret = default_interpret()
+    r.check(), t.check()
+    ra, lead = _flatten_to(r.a, 1)
+    ta, _ = _flatten_to(jnp.broadcast_to(t.a, r.a.shape), 1)
+    (o,) = _pcall(_pow16mul_k, [ra, ta], _CONSTS_RED, [(NL,)], interpret)
+    return lv(o.reshape(lead + (NL,)))
+
+
+def f2_pow16mul(r: LV, t: LV, interpret: bool | None = None) -> LV:
+    if interpret is None:
+        interpret = default_interpret()
+    r.check(), t.check()
+    ra, lead = _flatten_to(r.a, 2)
+    ta, _ = _flatten_to(jnp.broadcast_to(t.a, r.a.shape), 2)
+    (o,) = _pcall(_fq2pow16mul_k, [ra, ta], _CONSTS_RED_PAD, [(2, NL)], interpret)
+    return lv(o.reshape(lead + (2, NL)))
+
+
+def f_fold(x: LV, interpret: bool | None = None) -> LV:
+    """Explicit normalization to semi-strict (bound-reset for scan carries)."""
+    if interpret is None:
+        interpret = default_interpret()
+    x.check()
+    xa, lead = _flatten_to(x.a, 1)
+    (o,) = _pcall(_fold_k, [xa], _CONSTS_RED, [(NL,)], interpret)
+    return lv(o.reshape(lead + (NL,)))
+
+
+def f_canon(x: LV, interpret: bool | None = None) -> jnp.ndarray:
+    """Loose (..., 50) -> canonical residue digits (< p, fully strict)."""
+    if interpret is None:
+        interpret = default_interpret()
+    x.check()
+    xa, lead = _flatten_to(x.a, 1)
+    (o,) = _pcall(_canon_k, [xa], _CONSTS_CANON, [(NL,)], interpret)
+    return o.reshape(lead + (NL,))
+
+
+def f_is_zero(x: LV, interpret: bool | None = None) -> jnp.ndarray:
+    """x == 0 mod p on (..., 50); returns (...) bool."""
+    return jnp.all(f_canon(x, interpret) == 0, axis=-1)
+
+
+def f2_is_zero(x: LV, interpret: bool | None = None) -> jnp.ndarray:
+    """Fq2 zero test on (..., 2, 50); one stacked canonical reduction."""
+    return jnp.all(f_canon(LV(x.a, x.b), interpret) == 0, axis=(-2, -1))
