@@ -1,0 +1,158 @@
+"""Device hash-to-G2 (SSWU + 3-isogeny) over the fused Pallas kernel core.
+
+The fused twin of ops/htc.py's device stage (host sha256/hash_to_field is
+unchanged — crypto/bls/hash_to_curve.py).  Call-count engineering:
+
+- The two gprime evaluations (gx1, gx2) ride the same lane-stacked calls.
+- Both Legendre tests share ONE windowed chi scan (lanes stacked).
+- Cofactor clearing is NOT here: the dispatch merges its two scalar
+  ladders into the one batched G2 ladder (fused_verify).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..crypto.bls import hash_to_curve as H
+from ..crypto.bls.fields import P as P_INT
+from . import limbs as fl
+from . import tower as tw
+from .fused_core import LV, f2_mul, f2_sqr, f_canon, f_mul, ladd, lselect, lstack, lv
+from .fused_field import (
+    P_MINUS_1,
+    f2_inv,
+    f2_pow_static,
+    f2_sgn0,
+    f2_sqrt,
+    fi_pow_static,
+    lneg,
+    lc,
+)
+from .fused_points import Point, fq2_ns, point_add_complete
+from .htc import B_OVER_ZA, ISO_A, ISO_B, K1, K2, K3, K4, NEG_B_OVER_A, SSWU_Z
+
+
+def _const(arr: np.ndarray, like: LV) -> LV:
+    return lv(jnp.broadcast_to(jnp.asarray(arr), like.a.shape).astype(jnp.float32))
+
+
+def _gprime_lanes(xs, interpret=None):
+    """g'(x) = x^3 + A'x + B' for a list of x lanes — 2 lane-stacked calls."""
+    k = len(xs)
+    sq, _ = f2_sqr(lstack(xs, -3), interpret)
+    x2s = [LV(sq.a[..., i, :, :], sq.b) for i in range(k)]
+    a_c = _const(ISO_A, xs[0])
+    m = f2_mul(
+        lstack(x2s + xs, -3),
+        lstack(xs + [a_c] * k, -3),
+        interpret,
+    )
+    out = []
+    for i in range(k):
+        x3 = LV(m.a[..., i, :, :], m.b)
+        ax = LV(m.a[..., k + i, :, :], m.b)
+        out.append(ladd(ladd(x3, ax), _const(ISO_B, xs[0])))
+    return out
+
+
+def map_to_curve_sswu(u: LV, interpret=None):
+    """Simplified SWU onto E' (htc.map_to_curve_sswu, fused)."""
+    z = _const(SSWU_Z, u)
+    u2, _ = f2_sqr(u, interpret)
+    m1 = f2_mul(lstack([u2, u2], -3), lstack([u2, z], -3), interpret)
+    u4 = LV(m1.a[..., 0, :, :], m1.b)
+    zu2 = LV(m1.a[..., 1, :, :], m1.b)
+    z2 = f2_mul(z, z, interpret)
+    m2 = f2_mul(lstack([u4], -3), lstack([z2], -3), interpret)
+    z2u4 = LV(m2.a[..., 0, :, :], m2.b)
+    tv1 = ladd(z2u4, zu2)
+    tv1_zero = jnp.all(f_canon(tv1, interpret) == 0, axis=(-2, -1))
+    tv1_inv = f2_inv(tv1, interpret)
+    one = _const(tw.FQ2_ONE, u)
+    x1_reg = f2_mul(_const(NEG_B_OVER_A, u), ladd(one, tv1_inv), interpret)
+    x1 = lselect(tv1_zero, _const(B_OVER_ZA, u), x1_reg)
+    x2 = f2_mul(zu2, x1, interpret)
+    gx1, gx2 = _gprime_lanes([x1, x2], interpret)
+    # one shared chi scan for both Legendre tests
+    pair = lstack([gx1, gx2], -3)
+    p0, p1 = lc(pair, 0), lc(pair, 1)
+    compsq = f_mul(lstack([p0, p1], -2), lstack([p0, p1], -2), interpret)
+    norm = ladd(LV(compsq.a[..., 0, :], compsq.b), LV(compsq.a[..., 1, :], compsq.b))
+    chi = fi_pow_static(norm, (P_INT - 1) // 2, interpret)
+    not_sq = jnp.all(f_canon(chi, interpret) == jnp.asarray(P_MINUS_1), axis=-1)
+    square1 = ~not_sq[..., 0]
+    x = lselect(square1, x1, x2)
+    gx = lselect(square1, gx1, gx2)
+    y = f2_sqrt(gx, interpret)
+    flip = f2_sgn0(u, interpret) != f2_sgn0(y, interpret)
+    y = lselect(flip, lneg(y), y)
+    return x, y
+
+
+def _eval_polys(x: LV, interpret=None):
+    """All four isogeny polynomials by joint Horner over lane-stacked
+    multiplies (htc._eval_poly; K2 is one degree shorter, so its lane
+    joins one round late with accumulator x_den)."""
+    deg4 = [K1, K3, K4]  # 4 coefficients each
+    acc = [lv(jnp.broadcast_to(jnp.asarray(k[-1]), x.a.shape).astype(jnp.float32)) for k in deg4]
+    acc2 = lv(jnp.broadcast_to(jnp.asarray(K2[-1]), x.a.shape).astype(jnp.float32))
+    started2 = False
+    for step in (2, 1, 0):
+        lanes = acc + ([acc2] if started2 or step <= 1 else [])
+        if not started2 and step <= 1:
+            started2 = True
+        m = f2_mul(lstack(lanes, -3), LV(jnp.broadcast_to(x.a[..., None, :, :], lstack(lanes, -3).a.shape), x.b), interpret)
+        outs = [LV(m.a[..., i, :, :], m.b) for i in range(len(lanes))]
+        acc = [
+            ladd(outs[i], lv(jnp.broadcast_to(jnp.asarray(deg4[i][step]), x.a.shape).astype(jnp.float32)))
+            for i in range(3)
+        ]
+        if len(outs) > 3:
+            acc2 = ladd(outs[3], lv(jnp.broadcast_to(jnp.asarray(K2[step]), x.a.shape).astype(jnp.float32)))
+    return acc[0], acc2, acc[1], acc[2]  # x_num, x_den, y_num, y_den
+
+
+def iso_map(x: LV, y: LV, interpret=None):
+    """3-isogeny E' -> E2 with one shared inversion (htc.iso_map)."""
+    x_num, x_den, y_num, y_den = _eval_polys(x, interpret)
+    m = f2_mul(lstack([x_den], -3), lstack([y_den], -3), interpret)
+    dinv = f2_inv(LV(m.a[..., 0, :, :], m.b), interpret)
+    m2 = f2_mul(lstack([x_num, y_num], -3), lstack([y_den, x_den], -3), interpret)
+    xn_yd = LV(m2.a[..., 0, :, :], m2.b)
+    yn_xd = LV(m2.a[..., 1, :, :], m2.b)
+    m3 = f2_mul(
+        lstack([xn_yd, yn_xd], -3),
+        LV(jnp.broadcast_to(dinv.a[..., None, :, :], m2.a.shape), dinv.b),
+        interpret,
+    )
+    xm = LV(m3.a[..., 0, :, :], m3.b)
+    m4 = f2_mul(lstack([y], -3), lstack([LV(m3.a[..., 1, :, :], m3.b)], -3), interpret)
+    ym = LV(m4.a[..., 0, :, :], m4.b)
+    return xm, ym
+
+
+def map_to_curve_g2(u: LV, interpret=None) -> Point:
+    x, y = map_to_curve_sswu(u, interpret)
+    xm, ym = iso_map(x, y, interpret)
+    z = lv(jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE), xm.a.shape).astype(jnp.float32))
+    return (xm, ym, z)
+
+
+def hash_to_g2_pre_cofactor(u: LV, interpret=None) -> Point:
+    """Device stage up to (but excluding) cofactor clearing: both field
+    draws through SSWU+isogeny in one stacked call, then a complete add
+    (htc.hash_to_g2_device minus g2_clear_cofactor — the dispatch folds
+    the cofactor ladders into its merged G2 ladder).
+
+    u: (..., 2, 2, 50) — two Fq2 draws per message.
+    """
+    ns2 = fq2_ns(interpret)
+    u0 = LV(u.a[..., 0, :, :], u.b)
+    u1 = LV(u.a[..., 1, :, :], u.b)
+    both = lstack([u0, u1], axis=0)
+    q = map_to_curve_g2(both, interpret)
+    q0 = tuple(LV(c.a[0], c.b) for c in q)
+    q1 = tuple(LV(c.a[1], c.b) for c in q)
+    return point_add_complete(q0, q1, ns2, interpret)

@@ -1,0 +1,323 @@
+"""G1/G2 jacobian point formulas over the fused Pallas kernel core.
+
+The fused twin of ops/points.py: identical formulas, identical
+infinity/edge-case semantics (exact-zero Z for deliberate infinities,
+residue-zero predicates for adversarial inputs), but every multiply round
+is one lane-stacked Pallas kernel call and the residue predicates ride the
+fused canonical-reduction kernel instead of three serial lax.scan ripples
+per ladder iteration.
+
+Scan-carry bound discipline: point coordinates flowing through ladder
+scans are re-wrapped at COORD_B (all formula outputs stay well below it —
+point_double peaks at ~4.6k, add_core at ~1.6k; asserted at trace time by
+the LV glue).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..crypto.bls import curve as C
+from . import limbs as fl
+from . import tower as tw
+from .fused_core import (
+    LV,
+    f2_mul,
+    f_canon,
+    f_mul,
+    ladd,
+    lc,
+    lcast,
+    ldbl,
+    lneg,
+    lselect,
+    lstack,
+    lsub,
+    lv,
+)
+from .fused_field import f2_conj, f2_inv, fi_inv
+
+# scan-carry digit-bound contract for point coordinates
+COORD_B = 8192
+
+PSI_CX = tw.fq2_const(C.PSI_CX)
+PSI_CY = tw.fq2_const(C.PSI_CY)
+G1_GEN_NEG_AFFINE = (
+    fl.int_to_limbs(C.G1_GEN.x.n),
+    fl.int_to_limbs((-C.G1_GEN.y).n),
+)
+
+
+class FNS(NamedTuple):
+    """Fused field namespace: Fq (comp_ndim=1) or Fq2 (comp_ndim=2)."""
+
+    comp_ndim: int
+    mul: callable  # LV x LV -> LV, element-wise over stacked lanes
+    inv: callable
+    zero_const: np.ndarray
+    one_const: np.ndarray
+
+    def stack(self, elems):
+        return lstack(elems, axis=-(self.comp_ndim + 1))
+
+    def unstack(self, x: LV, k: int):
+        axis = x.a.ndim - (self.comp_ndim + 1)
+        return [LV(jnp.take(x.a, i, axis=axis), x.b) for i in range(k)]
+
+    def select(self, cond, a: LV, b: LV) -> LV:
+        c = cond.reshape(cond.shape + (1,) * self.comp_ndim)
+        return LV(jnp.where(c, a.a, b.a), max(a.b, b.b))
+
+    def is_exact_zero(self, x: LV):
+        axes = tuple(range(-self.comp_ndim, 0))
+        return jnp.all(x.a == 0, axis=axes)
+
+    def is_zero_mod(self, x: LV, interpret=None):
+        axes = tuple(range(-self.comp_ndim, 0))
+        return jnp.all(f_canon(x, interpret) == 0, axis=axes)
+
+
+def fq_ns(interpret=None) -> FNS:
+    return FNS(
+        comp_ndim=1,
+        mul=lambda a, b: f_mul(a, b, interpret),
+        inv=lambda a: fi_inv(a, interpret),
+        zero_const=fl.ZERO,
+        one_const=fl.ONE,
+    )
+
+
+def fq2_ns(interpret=None) -> FNS:
+    return FNS(
+        comp_ndim=2,
+        mul=lambda a, b: f2_mul(a, b, interpret),
+        inv=lambda a: f2_inv(a, interpret),
+        zero_const=tw.FQ2_ZERO,
+        one_const=tw.FQ2_ONE,
+    )
+
+
+Point = Tuple[LV, LV, LV]
+
+
+def point_infinity(ns: FNS, batch_shape=()) -> Point:
+    shape = batch_shape + ns.one_const.shape
+    one = lv(jnp.broadcast_to(jnp.asarray(ns.one_const), shape).astype(jnp.float32))
+    zero = lv(jnp.zeros(shape, dtype=jnp.float32))
+    return (one, one, zero)
+
+
+def point_from_affine(x: LV, y: LV, ns: FNS) -> Point:
+    z = lv(jnp.broadcast_to(jnp.asarray(ns.one_const), x.a.shape).astype(jnp.float32))
+    return (x, y, z)
+
+
+def point_is_infinity(p: Point, ns: FNS):
+    return ns.is_exact_zero(p[2])
+
+
+def point_select(cond, a: Point, b: Point, ns: FNS) -> Point:
+    return tuple(ns.select(cond, ai, bi) for ai, bi in zip(a, b))
+
+
+def point_cast(p: Point, bound: int = COORD_B) -> Point:
+    return tuple(lcast(c, bound) for c in p)
+
+
+def point_double(p: Point, ns: FNS) -> Point:
+    """2P jacobian (points.point_double, fused: 3 kernel calls)."""
+    x, y, z = p
+    s1 = ns.mul(ns.stack([x, y, y]), ns.stack([x, y, z]))
+    a, bb, yz = ns.unstack(s1, 3)
+    e = ladd(ladd(a, a), a)
+    xbb = ladd(x, bb)
+    s2 = ns.mul(ns.stack([xbb, bb, e]), ns.stack([xbb, bb, e]))
+    xbb2, c, f = ns.unstack(s2, 3)
+    d = ldbl(lsub(xbb2, ladd(a, c)))
+    x3 = lsub(f, ldbl(d))
+    c8 = ldbl(ldbl(ldbl(c)))
+    s3 = ns.mul(ns.stack([e]), ns.stack([lsub(d, x3)]))
+    (ed,) = ns.unstack(s3, 1)
+    y3 = lsub(ed, c8)
+    z3 = ldbl(yz)
+    return (x3, y3, z3)
+
+
+def _add_core(p: Point, q: Point, ns: FNS):
+    """Shared add machinery (points._add_core, fused: 6 kernel calls);
+    returns (x3, y3, z3, h, sdiff)."""
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    s1 = ns.mul(ns.stack([z1, z2]), ns.stack([z1, z2]))
+    z1z1, z2z2 = ns.unstack(s1, 2)
+    s2 = ns.mul(ns.stack([x1, x2, y1, y2]), ns.stack([z2z2, z1z1, z2z2, z1z1]))
+    u1, u2, s1y, s2y = ns.unstack(s2, 4)
+    s3 = ns.mul(ns.stack([s1y, s2y]), ns.stack([z2, z1]))
+    s1f, s2f = ns.unstack(s3, 2)
+    h = lsub(u2, u1)
+    sdiff = lsub(s2f, s1f)
+    r = ldbl(sdiff)
+    hh = ldbl(h)
+    zsum = ladd(z1, z2)
+    s4 = ns.mul(ns.stack([hh, r, zsum]), ns.stack([hh, r, zsum]))
+    i, r2, zsum2 = ns.unstack(s4, 3)
+    s5 = ns.mul(ns.stack([h, u1]), ns.stack([i, i]))
+    j, v = ns.unstack(s5, 2)
+    x3 = lsub(r2, ladd(j, ldbl(v)))
+    s6 = ns.mul(
+        ns.stack([r, s1f, lsub(zsum2, ladd(z1z1, z2z2))]),
+        ns.stack([lsub(v, x3), j, h]),
+    )
+    rvx, s1j, z3 = ns.unstack(s6, 3)
+    y3 = lsub(rvx, ldbl(s1j))
+    return x3, y3, z3, h, sdiff
+
+
+def point_add_unsafe(p: Point, q: Point, ns: FNS) -> Point:
+    """Jacobian add; correct when p != +-q (or either is infinity)."""
+    x3, y3, z3, _, _ = _add_core(p, q, ns)
+    p_inf = point_is_infinity(p, ns)
+    q_inf = point_is_infinity(q, ns)
+    out = (x3, y3, z3)
+    out = point_select(q_inf, p, out, ns)
+    out = point_select(p_inf, q, out, ns)
+    return out
+
+
+def point_add_complete(p: Point, q: Point, ns: FNS, interpret=None) -> Point:
+    """Full equal/opposite/2-torsion select ladder (points.point_add_complete
+    semantics).  All six residue predicates ride ONE fused canonical
+    reduction instead of three serial scan ripples."""
+    x3, y3, z3, h, sdiff = _add_core(p, q, ns)
+    stacked = ns.stack([p[2], q[2], h, sdiff, p[1]])
+    axes = tuple(range(-ns.comp_ndim, 0))
+    zeros = jnp.all(f_canon(stacked, interpret) == 0, axis=axes)
+    axis = zeros.ndim - 1
+    p_inf = jnp.take(zeros, 0, axis=axis)
+    q_inf = jnp.take(zeros, 1, axis=axis)
+    eq_x = jnp.take(zeros, 2, axis=axis)
+    eq_y = jnp.take(zeros, 3, axis=axis)
+    y1_zero = jnp.take(zeros, 4, axis=axis)
+    dbl_raw = point_double(p, ns)
+    inf = point_infinity(ns, batch_shape=p_inf.shape)
+    dbl = point_select(y1_zero | p_inf, inf, dbl_raw, ns)
+    out = (x3, y3, z3)
+    out = point_select(eq_x & ~eq_y & ~p_inf & ~q_inf, inf, out, ns)
+    out = point_select(eq_x & eq_y & ~p_inf & ~q_inf, dbl, out, ns)
+    out = point_select(q_inf, p, out, ns)
+    out = point_select(p_inf, q, out, ns)
+    return out
+
+
+def point_mul_bits(
+    p: Point, bits: jnp.ndarray, ns: FNS, complete: bool = False, interpret=None
+) -> Point:
+    """[k]P with per-lane dynamic scalars; bits (..., NBITS) LSB-first.
+
+    Double-and-add over a lax.scan; ``complete`` picks the safe adder.
+    Different lanes may carry different bit streams — the merged-ladder
+    path stacks independent scalar multiplications (subgroup check,
+    cofactor terms, RLC coefficients) into ONE scan."""
+    nbits = bits.shape[-1]
+    acc = point_infinity(ns, batch_shape=bits.shape[:-1])
+
+    def body(carry, i):
+        acc_a, add_a = carry
+        acc = point_cast(tuple(lv(a, COORD_B) for a in acc_a))
+        addend = point_cast(tuple(lv(a, COORD_B) for a in add_a))
+        bit = jnp.take(bits, i, axis=-1).astype(bool)
+        if complete:
+            added = point_add_complete(acc, addend, ns, interpret)
+        else:
+            added = point_add_unsafe(acc, addend, ns)
+        acc = point_select(bit, added, acc, ns)
+        addend = point_double(addend, ns)
+        for c in acc + addend:
+            assert c.b <= COORD_B, c.b
+        return (tuple(c.a for c in acc), tuple(c.a for c in addend)), None
+
+    p0 = point_cast(tuple(lcast(c, COORD_B) for c in p))
+    (acc_a, _), _ = lax.scan(
+        body,
+        (tuple(c.a for c in acc), tuple(c.a for c in p0)),
+        jnp.arange(nbits),
+    )
+    return tuple(lv(a, COORD_B) for a in acc_a)
+
+
+def point_sum_tree(p: Point, ns: FNS) -> Point:
+    """Reduce batch axis 0 by pairwise tree addition (unsafe adds — RLC
+    randomized operands)."""
+    x, y, z = p
+    while x.a.shape[0] > 1:
+        n = x.a.shape[0]
+        if n % 2:
+            inf = point_infinity(
+                ns, batch_shape=(1,) + x.a.shape[1 : x.a.ndim - ns.comp_ndim]
+            )
+            x = lconcat_pair(x, inf[0])
+            y = lconcat_pair(y, inf[1])
+            z = lconcat_pair(z, inf[2])
+            n += 1
+        half = n // 2
+        (x, y, z) = point_add_unsafe(
+            (LV(x.a[:half], x.b), LV(y.a[:half], y.b), LV(z.a[:half], z.b)),
+            (LV(x.a[half:], x.b), LV(y.a[half:], y.b), LV(z.a[half:], z.b)),
+            ns,
+        )
+    return (LV(x.a[0], x.b), LV(y.a[0], y.b), LV(z.a[0], z.b))
+
+
+def lconcat_pair(x: LV, y: LV) -> LV:
+    return LV(jnp.concatenate([x.a, y.a]), max(x.b, y.b))
+
+
+def point_eq(p: Point, q: Point, ns: FNS, interpret=None):
+    """X1 Z2^2 == X2 Z1^2 and Y1 Z2^3 == Y2 Z1^3 with infinity handling —
+    predicates on one stacked canonical reduction."""
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    s1 = ns.mul(ns.stack([z1, z2]), ns.stack([z1, z2]))
+    z1z1, z2z2 = ns.unstack(s1, 2)
+    s2 = ns.mul(ns.stack([x1, x2, y1, y2]), ns.stack([z2z2, z1z1, z2z2, z1z1]))
+    u1, u2, t1, t2 = ns.unstack(s2, 4)
+    s3 = ns.mul(ns.stack([t1, t2]), ns.stack([z2, z1]))
+    s1f, s2f = ns.unstack(s3, 2)
+    stacked = ns.stack([lsub(u1, u2), lsub(s1f, s2f)])
+    axes = tuple(range(-ns.comp_ndim, 0))
+    zeros = jnp.all(f_canon(stacked, interpret) == 0, axis=axes)
+    axis = zeros.ndim - 1
+    same = jnp.take(zeros, 0, axis=axis) & jnp.take(zeros, 1, axis=axis)
+    p_inf = point_is_infinity(p, ns)
+    q_inf = point_is_infinity(q, ns)
+    return jnp.where(p_inf | q_inf, p_inf & q_inf, same)
+
+
+def point_to_affine(p: Point, ns: FNS):
+    """(X/Z^2, Y/Z^3); caller masks infinities."""
+    zinv = ns.inv(p[2])
+    s = ns.mul(ns.stack([zinv]), ns.stack([zinv]))
+    (zinv2,) = ns.unstack(s, 1)
+    s2 = ns.mul(ns.stack([p[0], zinv2]), ns.stack([zinv2, zinv]))
+    xa, zinv3 = ns.unstack(s2, 2)
+    s3 = ns.mul(ns.stack([p[1]]), ns.stack([zinv3]))
+    (ya,) = ns.unstack(s3, 1)
+    return xa, ya
+
+
+def psi(p: Point, interpret=None) -> Point:
+    """Untwist-Frobenius-twist endomorphism (points.psi, fused)."""
+    x, y, z = p
+    cx = lv(jnp.broadcast_to(jnp.asarray(PSI_CX), x.a.shape))
+    cy = lv(jnp.broadcast_to(jnp.asarray(PSI_CY), y.a.shape))
+    s = f2_mul(
+        lstack([f2_conj(x), f2_conj(y)], axis=-3),
+        lstack([cx, cy], axis=-3),
+        interpret,
+    )
+    return (LV(s.a[..., 0, :, :], s.b), LV(s.a[..., 1, :, :], s.b), f2_conj(z))
