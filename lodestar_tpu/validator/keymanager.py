@@ -65,8 +65,16 @@ class KeymanagerApi:
             self.protection.import_interchange(
                 json.loads(interchange) if isinstance(interchange, str) else interchange
             )
+        # one status per submitted keystore, always: unmatched trailing
+        # entries get explicit error statuses instead of being silently
+        # dropped by zip (keymanager API contract)
+        if len(passwords) < len(keystores):
+            passwords = list(passwords) + [None] * (len(keystores) - len(passwords))
         statuses = []
         for raw, password in zip(keystores, passwords):
+            if password is None:
+                statuses.append({"status": "error", "message": "missing password"})
+                continue
             try:
                 ks = json.loads(raw) if isinstance(raw, str) else raw
                 secret = decrypt_keystore(ks, password)
@@ -202,7 +210,6 @@ class KeymanagerApi:
         return {}
 
     def delete_keystores(self, body: dict) -> dict:
-        wanted = {bytes.fromhex(pk[2:]) for pk in body.get("pubkeys", [])}
         statuses = []
         for pk in body.get("pubkeys", []):
             raw = bytes.fromhex(pk[2:])
@@ -210,7 +217,11 @@ class KeymanagerApi:
             if idx is None:
                 statuses.append({"status": "not_found", "message": ""})
                 continue
-            del self.store.keys[idx]
+            if self.store.keys.pop(idx, None) is None:
+                # remote-only pubkey: not a local keystore (keymanager spec
+                # says report it, don't 500 the whole request)
+                statuses.append({"status": "not_found", "message": "remote key"})
+                continue
             del self.store.pubkeys[idx]
             statuses.append({"status": "deleted", "message": ""})
         # export the whole protection history for the deleted keys' owner
@@ -224,10 +235,34 @@ class KeymanagerApi:
 class KeymanagerServer:
     """Minimal asyncio HTTP host for the keymanager routes (the VC-side
     analog of BeaconRestApiServer; bearer-token auth like the reference's
-    keymanager server)."""
+    keymanager server).
 
-    def __init__(self, api: KeymanagerApi, token: Optional[str] = None, host: str = "127.0.0.1"):
+    Auth is ON by default: like the reference (which always writes an
+    api-token.txt and enforces it), a missing token is GENERATED, not
+    skipped — key import/delete and fee-recipient routes must never be
+    open by accident.  Pass ``require_auth=False`` to explicitly disable
+    (tests/local tooling only).  ``token_path`` persists the generated
+    token for operator tooling."""
+
+    def __init__(
+        self,
+        api: KeymanagerApi,
+        token: Optional[str] = None,
+        host: str = "127.0.0.1",
+        require_auth: bool = True,
+        token_path: Optional[str] = None,
+    ):
         self.api = api
+        if token is None and require_auth:
+            import secrets
+
+            token = "api-token-0x" + secrets.token_hex(32)
+            if token_path:
+                import os
+
+                with open(token_path, "w") as fh:
+                    fh.write(token)
+                os.chmod(token_path, 0o600)
         self.token = token
         self.host = host
         self.port: Optional[int] = None
@@ -279,8 +314,10 @@ class KeymanagerServer:
 
     def _dispatch(self, method: str, path: str, headers: dict, body: bytes):
         if self.token:
+            import hmac
+
             auth = headers.get("authorization", "")
-            if auth != f"Bearer {self.token}":
+            if not hmac.compare_digest(auth, f"Bearer {self.token}"):
                 return 401, {"code": 401, "message": "missing or bad bearer token"}
         try:
             parsed = json.loads(body) if body else {}

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import ssl
 from typing import List
 from urllib.parse import urlparse
 
@@ -28,11 +29,25 @@ class RemoteSignerClient:
         self.url = url
         parsed = urlparse(url)
         self.host = parsed.hostname or "127.0.0.1"
-        self.port = parsed.port or (443 if parsed.scheme == "https" else 80)
+        self.scheme = parsed.scheme or "http"
+        self.port = parsed.port or (443 if self.scheme == "https" else 80)
         self.timeout = timeout
 
+    def _connect(self):
+        """https URLs negotiate TLS with certificate verification — signing
+        requests must never leave the process in cleartext against a TLS
+        signer (advisor round-4 finding)."""
+        if self.scheme == "https":
+            return http.client.HTTPSConnection(
+                self.host,
+                self.port,
+                timeout=self.timeout,
+                context=ssl.create_default_context(),
+            )
+        return http.client.HTTPConnection(self.host, self.port, timeout=self.timeout)
+
     def _request(self, method: str, path: str, body: dict | None = None):
-        conn = http.client.HTTPConnection(self.host, self.port, timeout=self.timeout)
+        conn = self._connect()
         try:
             payload = json.dumps(body).encode() if body is not None else None
             conn.request(

@@ -222,6 +222,12 @@ class SlashingProtection:
                 self._attestations.setdefault(pk, []).append(
                     (int(att["source_epoch"]), int(att["target_epoch"]), root)
                 )
+        # migrated protection history must be durable BEFORE any signature
+        # is released: a crash between a keymanager import and the next
+        # auto-checkpoint would otherwise re-enable double-signing
+        # (advisor round-4 finding)
+        if self.persist_path:
+            self.checkpoint()
 
     def export_json(self) -> str:
         return json.dumps(self.export_interchange(), indent=2)
