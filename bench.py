@@ -345,6 +345,56 @@ def bench_dev_chain(time_budget_s: float = 150.0):
         return None
 
 
+def bench_range_sync(time_budget_s: float = 240.0):
+    """blocks/s replaying a multi-epoch dev-chain segment through
+    process_chain_segment on a FRESH chain — the range-sync throughput of
+    BASELINE.md configs #4/#5 (reference: sync/range/chain.ts:85 feeding
+    1000+ signature sets per batch to the worker pool).  Cross-block
+    batching means the whole segment verifies in a handful of dispatches."""
+    import asyncio
+    import time as _t
+
+    from lodestar_tpu.chain.bls_pool import BlsBatchPool
+    from lodestar_tpu.config.chain_config import ChainConfig
+    from lodestar_tpu.crypto.bls.tpu_verifier import TpuBlsVerifier
+    from lodestar_tpu.node.dev_chain import DevChain
+    from lodestar_tpu.params import MINIMAL
+
+    cfg = ChainConfig(
+        PRESET_BASE="minimal", MIN_GENESIS_TIME=0, SHARD_COMMITTEE_PERIOD=0,
+        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
+        ALTAIR_FORK_EPOCH=2**64 - 1, BELLATRIX_FORK_EPOCH=2**64 - 1,
+    )
+
+    async def run():
+        t_start = _t.perf_counter()
+        verifier = TpuBlsVerifier(buckets=(128,))
+        pool = BlsBatchPool(verifier, max_buffer_wait=0.005)
+        # build a 2-epoch segment on a producer chain
+        producer = DevChain(MINIMAL, cfg, 16, pool)
+        segment = []
+        nslots = 2 * MINIMAL.SLOTS_PER_EPOCH
+        for slot in range(1, 1 + nslots):
+            root = await producer.advance_slot(slot)
+            segment.append(producer.chain.get_block_by_root(root))
+            if _t.perf_counter() - t_start > time_budget_s:
+                pool.close()
+                return None
+        # replay through a fresh chain (same genesis) via the segment path
+        consumer = DevChain(MINIMAL, cfg, 16, pool)
+        t0 = _t.perf_counter()
+        n = await consumer.chain.process_chain_segment(segment)
+        dt = _t.perf_counter() - t0
+        pool.close()
+        assert n == len(segment), f"only {n}/{len(segment)} imported"
+        return n / dt
+
+    try:
+        return asyncio.run(asyncio.wait_for(run(), time_budget_s * 2))
+    except asyncio.TimeoutError:
+        return None
+
+
 def _retry(fn, *a, retries=1, default=None):
     """Transient axon tunnel errors ('response body closed' mid
     remote_compile) must not kill the gate: retry, then return `default`
@@ -384,6 +434,7 @@ def main() -> None:
     cpu_oracle = bench_cpu_oracle()
     small_dt = _retry(bench_small_bucket)
     chain_rate = _retry(bench_dev_chain)
+    range_rate = _retry(bench_range_sync)
     scale = _retry(bench_scale_250k)
     import jax
 
@@ -408,6 +459,7 @@ def main() -> None:
                     "cpu_oracle_sets_per_s": round(cpu_oracle, 3),
                     "baseline_kind": "fastbls-c" if cpu_native else "python-oracle",
                     "dev_chain_blocks_per_s": round(chain_rate, 3) if chain_rate else None,
+                    "range_sync_blocks_per_s": round(range_rate, 3) if range_rate else None,
                     "scale_250k": scale,
                     "backend": jax.default_backend(),
                 },

@@ -36,7 +36,14 @@ from .peer import (
     ScoreState,
 )
 from .reqresp import ReqRespNode
-from .wire import KIND_GOSSIP, KIND_REQUEST, KIND_RESPONSE_CHUNK, KIND_RESPONSE_END, Wire
+from .wire import (
+    KIND_GOSSIP,
+    KIND_GOSSIP_CTRL,
+    KIND_REQUEST,
+    KIND_RESPONSE_CHUNK,
+    KIND_RESPONSE_END,
+    Wire,
+)
 
 logger = get_logger("network")
 
@@ -51,7 +58,9 @@ class Network:
         self.port: Optional[int] = None
         self.peer_manager = PeerManager()
         self.score_store = PeerRpcScoreStore()
-        self.router = GossipRouter(on_reject=self._on_gossip_reject)
+        self.router = GossipRouter(
+            on_reject=self._on_gossip_reject, on_evict=self._on_gossip_evict
+        )
         # subnet services + seq-numbered metadata (SURVEY §2.5 attnets/
         # syncnets; served to peers over reqresp METADATA)
         from .subnets import AttnetsService, MetadataController, SyncnetsService
@@ -114,6 +123,7 @@ class Network:
     async def listen(self, port: int = 0) -> int:
         self._server = await asyncio.start_server(self._on_inbound, self.host, port)
         self.port = self._server.sockets[0].getsockname()[1]
+        self.router.start()
         logger.info("listening on %s:%d", self.host, self.port)
         return self.port
 
@@ -122,6 +132,7 @@ class Network:
         return await self._setup_peer(reader, writer, initiator=True)
 
     async def close(self) -> None:
+        self.router.stop()
         if self.discovery is not None:
             await self.discovery.close()
         for peer in self.peer_manager.connected():
@@ -161,13 +172,19 @@ class Network:
         async def gossip_send(topic: str, ssz_bytes: bytes) -> None:
             await wire.send_frame(KIND_GOSSIP, Wire.encode_gossip(topic, ssz_bytes))
 
-        peer._gossip_send = gossip_send
-        self.router.add_peer_sender(gossip_send)
+        async def gossip_ctrl(ctrl: dict) -> None:
+            await wire.send_frame(KIND_GOSSIP_CTRL, Wire.encode_gossip_ctrl(ctrl))
+
+        # mesh identity is the CONNECTION (peer_id): score identity stays
+        # the remote host, but distinct peers on one host must hold
+        # distinct mesh slots
+        self.router.add_peer(peer.peer_id, gossip_send, gossip_ctrl)
         self.peer_manager.add(peer)
         if self.metrics:
             self.metrics.peers.set(len(self.peer_manager.peers))
         task = asyncio.create_task(self._read_loop(peer))
         peer.tasks.append(task)
+        await self.router.announce_subscriptions(peer.peer_id)
         if initiator:
             await self.peer_manager.handshake(peer, reqresp.local_status())
         return peer
@@ -184,8 +201,11 @@ class Network:
                     topic, data = Wire.decode_gossip(payload)
                     if self.metrics:
                         self.metrics.gossip_messages_total.labels(dir="rx").inc()
-                    await self.router.on_message(topic, data, from_peer=peer.remote_key)
+                    await self.router.on_message(topic, data, from_peer=peer.peer_id)
                     await self._enforce_score(peer)
+                elif kind == KIND_GOSSIP_CTRL:
+                    ctrl = Wire.decode_gossip_ctrl(payload)
+                    await self.router.on_control(peer.peer_id, ctrl)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         except Exception as e:  # noqa: BLE001
@@ -201,7 +221,7 @@ class Network:
         self.peer_manager.remove(peer.peer_id)
         if self.metrics:
             self.metrics.peers.set(len(self.peer_manager.peers))
-        self.router.remove_peer_sender(getattr(peer, "_gossip_send", None))
+        self.router.remove_peer(peer.peer_id)
         peer.wire.close()
         for t in peer.tasks:
             if t is not asyncio.current_task():
@@ -211,8 +231,20 @@ class Network:
 
     def _on_gossip_reject(self, peer_key: str, code: str) -> None:
         """Router callback: an invalid (REJECT) gossip message is provable
-        misbehavior — downscore the sender."""
-        self.score_store.apply_action(peer_key, PeerAction.LOW_TOLERANCE, f"gossip:{code}")
+        misbehavior — downscore the sender (router keys are connection ids;
+        the score store keys on the remote host)."""
+        peer = self.peer_manager.get(peer_key)
+        key = peer.remote_key if peer is not None else peer_key
+        self.score_store.apply_action(key, PeerAction.LOW_TOLERANCE, f"gossip:{code}")
+
+    def _on_gossip_evict(self, peer_key: str, score: float) -> None:
+        """Router callback: gossip score fell below the graylist
+        threshold (scoringParameters.ts gossipScoreThresholds) — drop the
+        peer."""
+        peer = self.peer_manager.get(peer_key)
+        if peer is not None:
+            logger.info("evicting peer %s (gossip score %.0f)", peer_key, score)
+            asyncio.ensure_future(self._drop_peer(peer, goodbye=True))
 
     async def report_peer(self, peer: Peer, action: PeerAction, reason: str = "") -> None:
         """Apply a score action and enforce the resulting state (the

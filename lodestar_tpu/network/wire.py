@@ -8,6 +8,9 @@ kinds:
     0x02 RESPONSE_CHUNK payload = [req_id: uvarint][result: 1 byte][ssz_snappy]
     0x03 RESPONSE_END   payload = [req_id: uvarint]
     0x04 GOSSIP         payload = [topic_len: uvarint][topic utf8][ssz_snappy]
+    0x05 GOSSIP_CTRL    payload = gossipsub control record (see
+         encode_gossip_ctrl): SUB/UNSUB/GRAFT/PRUNE topic lists + IHAVE
+         (topic, message-id list) + IWANT (message-id list)
 
 ssz_snappy = snappy *frame* compression of the SSZ bytes, matching the
 reference's req/resp encoding (network/reqresp/encodingStrategies) via the
@@ -25,6 +28,9 @@ KIND_REQUEST = 0x01
 KIND_RESPONSE_CHUNK = 0x02
 KIND_RESPONSE_END = 0x03
 KIND_GOSSIP = 0x04
+KIND_GOSSIP_CTRL = 0x05
+
+MSG_ID_LEN = 20
 
 RESULT_SUCCESS = 0
 RESULT_INVALID_REQUEST = 1
@@ -152,3 +158,82 @@ class Wire:
         tlen, off = read_uvarint(payload)
         topic = payload[off : off + tlen].decode()
         return topic, frame_uncompress(payload[off + tlen :], max_output=MAX_UNCOMPRESSED)
+
+    # -- gossipsub control records ---------------------------------------------
+
+    @staticmethod
+    def _enc_topics(topics) -> bytes:
+        out = write_uvarint(len(topics))
+        for t in topics:
+            tb = t.encode()
+            out += write_uvarint(len(tb)) + tb
+        return out
+
+    @staticmethod
+    def _dec_topics(payload: bytes, off: int):
+        n, off = read_uvarint(payload, off)
+        if n > 4096:
+            raise ValueError("too many topics")
+        topics = []
+        for _ in range(n):
+            tlen, off = read_uvarint(payload, off)
+            topics.append(payload[off : off + tlen].decode())
+            off += tlen
+        return topics, off
+
+    @staticmethod
+    def encode_gossip_ctrl(ctrl: dict) -> bytes:
+        """ctrl keys: sub/unsub/graft/prune (topic lists), ihave (list of
+        (topic, [20-byte ids])), iwant ([20-byte ids])."""
+        out = b""
+        for key in ("sub", "unsub", "graft", "prune"):
+            out += Wire._enc_topics(ctrl.get(key, []))
+        ihave = ctrl.get("ihave", [])
+        out += write_uvarint(len(ihave))
+        for topic, ids in ihave:
+            tb = topic.encode()
+            out += write_uvarint(len(tb)) + tb + write_uvarint(len(ids))
+            for mid in ids:
+                out += bytes(mid[:MSG_ID_LEN]).ljust(MSG_ID_LEN, b"\x00")
+        iwant = ctrl.get("iwant", [])
+        out += write_uvarint(len(iwant))
+        for mid in iwant:
+            out += bytes(mid[:MSG_ID_LEN]).ljust(MSG_ID_LEN, b"\x00")
+        return out
+
+    @staticmethod
+    def decode_gossip_ctrl(payload: bytes) -> dict:
+        ctrl: dict = {}
+        off = 0
+        for key in ("sub", "unsub", "graft", "prune"):
+            topics, off = Wire._dec_topics(payload, off)
+            if topics:
+                ctrl[key] = topics
+        n, off = read_uvarint(payload, off)
+        if n > 4096:
+            raise ValueError("too many ihave entries")
+        ihave = []
+        for _ in range(n):
+            tlen, off = read_uvarint(payload, off)
+            topic = payload[off : off + tlen].decode()
+            off += tlen
+            k, off = read_uvarint(payload, off)
+            if k > 16384:
+                raise ValueError("too many ihave ids")
+            ids = []
+            for _ in range(k):
+                ids.append(payload[off : off + MSG_ID_LEN])
+                off += MSG_ID_LEN
+            ihave.append((topic, ids))
+        if ihave:
+            ctrl["ihave"] = ihave
+        k, off = read_uvarint(payload, off)
+        if k > 16384:
+            raise ValueError("too many iwant ids")
+        iwant = []
+        for _ in range(k):
+            iwant.append(payload[off : off + MSG_ID_LEN])
+            off += MSG_ID_LEN
+        if iwant:
+            ctrl["iwant"] = iwant
+        return ctrl
