@@ -219,3 +219,7 @@ class DevChain:
         start = state.slot + 1
         for slot in range(start, start + n_slots):
             await self.advance_slot(slot, with_attestations)
+            # the manual-clock analog of the 2/3-slot prepare tick: the
+            # next slot's state (including any epoch transition) is
+            # precomputed off the import path (prepareNextSlot.ts:30)
+            await self.chain.prepare_scheduler.prepare(slot + 1)

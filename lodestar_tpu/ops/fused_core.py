@@ -65,7 +65,9 @@ from .pallas_tower import (
 # configuration
 # ---------------------------------------------------------------------------
 
-BLK = 256  # grid block rows: one Mosaic compile per kernel, any batch size
+BLK = 512  # grid block rows: one Mosaic compile per kernel, any batch size
+# (512 rows ~ 13 MB scoped VMEM in the mul kernels — close to but under the
+# 16 MB limit; halves the per-block constant DMA vs 256)
 
 # Hard ceiling for digits entering any kernel: the entry normalization
 # (_fold50 at bound 22) is f32-exact only below 2^22.
@@ -174,6 +176,175 @@ def lc(x: LV, i: int, axis: int = -2) -> LV:
 
 
 # ---------------------------------------------------------------------------
+# MXU in-kernel field core (round-5 probe 3/5 results)
+#
+# The schoolbook ladder's 50 lane-axis shifts/broadcasts were the compute
+# bottleneck (~110 us per fq2_mul call).  All positional movement is now
+# matmul against constant one-hot matrices, EXACT BY CONSTRUCTION:
+# every matmul input is an integer <= 2^8 (exactly representable in bf16 —
+# larger operands are split into <=2^8 slices first), accumulated in f32
+# with partial sums < 2^23.  Digit products ride the MXU:
+#   P[b, i*50+j] = (a @ REP)[b,ij] * (b @ TIL)[b,ij]   (one vector mul)
+#   acc = split(P) @ W          (anti-diagonal one-hot, 99 outputs)
+#   fold = carry(acc) @ F       (identity rows + RED rows)
+# Verified bit-exact vs the bigint oracle on the TPU across 256x1024
+# chained products (.probe/r5_mxu.py).
+# ---------------------------------------------------------------------------
+
+_ACCW = 2 * NL - 1  # 99
+
+# anti-diagonal accumulation one-hot: W[(i*NL+j), i+j] = 1
+_W_MAT = np.zeros((NL * NL, _ACCW), np.float32)
+for _i in range(NL):
+    for _j in range(NL):
+        _W_MAT[_i * NL + _j, _i + _j] = 1.0
+
+# repeat/tile one-hots (Mosaic cannot reshape (B,50,50)->(B,2500); the
+# flat outer product is built as (a @ REP) * (b @ TIL) instead)
+_REP_MAT = np.zeros((NL, NL * NL), np.float32)
+_TIL_MAT = np.zeros((NL, NL * NL), np.float32)
+for _i in range(NL):
+    for _j in range(NL):
+        _REP_MAT[_i, _i * NL + _j] = 1.0
+        _TIL_MAT[_j, _i * NL + _j] = 1.0
+
+# fold matrix: digit positions 0..48 pass through, 49.. fold via RED rows
+_FOLD_W = 102
+_F_MAT = np.zeros((_FOLD_W, NL), np.float32)
+for _i in range(NL - 1):
+    _F_MAT[_i, _i] = 1.0
+for _r in range(_FOLD_W - (NL - 1)):
+    _F_MAT[NL - 1 + _r] = fl.RED[_r]
+
+_BF = jnp.bfloat16
+
+
+class MC(NamedTuple):
+    """In-kernel constant bundle (kernel operands, never closures).
+    The matmul matrices travel as bf16 — every entry is an integer
+    <= 255 (one-hots and RED digits), exactly representable, and halving
+    the per-block DMA measurably matters.  The subtraction pad stays f32
+    (digits ~2^12 exceed bf16's 8-bit mantissa)."""
+
+    w: jnp.ndarray    # (2500, 99) bf16
+    f: jnp.ndarray    # (102, 50) bf16
+    rep: jnp.ndarray  # (50, 2500) bf16
+    til: jnp.ndarray  # (50, 2500) bf16
+    pad: jnp.ndarray  # (50,) f32 bias-2^12 subtraction pad
+
+
+import ml_dtypes as _mld
+
+_MC_CONSTS = (
+    _W_MAT.astype(_mld.bfloat16),
+    _F_MAT.astype(_mld.bfloat16),
+    _REP_MAT.astype(_mld.bfloat16),
+    _TIL_MAT.astype(_mld.bfloat16),
+    SUBPAD,
+)
+
+
+def _m_dot(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """bf16 x bf16 -> f32 matmul; exact when both sides are integers
+    <= 2^8 and output sums < 2^24."""
+    return jax.lax.dot_general(
+        x.astype(_BF),
+        w.astype(_BF),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _m_split_dot(x: jnp.ndarray, w: jnp.ndarray, bound_bits: int) -> jnp.ndarray:
+    """Exact x @ w for integer x <= 2^bound_bits (INCLUSIVE — semi-strict
+    digits may be exactly 256) via <=2^8 slice splitting.  The LAST slice
+    is used whole: after k-1 splits it is <= 2^(bound-8(k-1)) <= 256,
+    and every integer <= 256 is exactly representable in bf16."""
+    slices = max(1, -(-bound_bits // 8))
+    acc = None
+    scale = np.float32(1.0)
+    for s in range(slices):
+        if s == slices - 1:
+            part = x
+        else:
+            hi = jnp.floor(x * np.float32(1.0 / 256.0))
+            part = x - hi * np.float32(256.0)
+            x = hi
+        d = _m_dot(part, w)
+        d = d if scale == 1.0 else d * scale
+        acc = d if acc is None else acc + d
+        scale = np.float32(scale * 256.0)
+    return acc
+
+
+def _m_carry(x: jnp.ndarray, bound_bits: int) -> jnp.ndarray:
+    """Value-preserving digit folds to <= 256 (pad+add shifts; few ops)."""
+    extra = max(1, -(-(bound_bits - 8) // 8))
+    x = jnp.pad(x, ((0, 0), (0, extra)))
+    b = (1 << bound_bits) - 1
+    while b > 256:
+        hi = jnp.floor(x * np.float32(1.0 / 256.0))
+        lo = x - hi * np.float32(256.0)
+        hi_up = jnp.concatenate(
+            [jnp.zeros((x.shape[0], 1), jnp.float32), hi[:, :-1]], axis=1
+        )
+        x = lo + hi_up
+        b = 255 + b // 256
+    return x
+
+
+def m_fold(x: jnp.ndarray, c: MC, bound_bits: int = 22) -> jnp.ndarray:
+    """Loose (B, W<=102) -> semi-strict (B, 50): carry, fold-dot, carry."""
+    x = _m_carry(x, bound_bits)  # digits <= 256 (bf16-exact)
+    if x.shape[1] < _FOLD_W:
+        x = jnp.pad(x, ((0, 0), (0, _FOLD_W - x.shape[1])))
+    y = _m_dot(x, c.f)  # < 52 * 2^16 < 2^22
+    return _m_carry(y, 22)[:, :NL]
+
+
+def m_mul(a: jnp.ndarray, b: jnp.ndarray, c: MC, bits: int = 16) -> jnp.ndarray:
+    """a * b mod p -> semi-strict; bits = a_bits + b_bits, the product
+    digit bound.  HARD CAP 18: the anti-diagonal accumulation sums up to
+    50 products, and 50 * 2^18 < 2^24 is the f32-exact ceiling (bits=22
+    was observed to silently round)."""
+    if bits > 18:
+        raise ValueError(f"m_mul bits={bits} breaks 50*2^bits < 2^24 exactness")
+    a_rep = _m_split_dot(a, c.rep, max(8, bits - 8))
+    b_til = _m_split_dot(b, c.til, max(8, bits - 8))
+    prod = a_rep * b_til  # (B, 2500) <= 2^bits, f32 exact
+    acc = _m_split_dot(prod, c.w, bits)  # (B, 99) < 50 * 2^bits < 2^24
+    return m_fold(acc, c, min(24, bits + 6))
+
+
+def m_add(a: jnp.ndarray, b: jnp.ndarray, c: MC) -> jnp.ndarray:
+    """ss + ss -> ss."""
+    return m_fold(a + b, c, 10)
+
+
+def m_sub(a: jnp.ndarray, b: jnp.ndarray, c: MC) -> jnp.ndarray:
+    """ss - ss mod p -> ss (bias-2^12 pad: subtrahend digits < 2^12)."""
+    return m_fold(a + (c.pad[None, :] - b), c, 13)
+
+
+def m_fq2_mul(a, b, c: MC):
+    """Karatsuba on ss component pairs -> ss pair."""
+    t0 = m_mul(a[0], b[0], c)
+    t1 = m_mul(a[1], b[1], c)
+    t2 = m_mul(a[0] + a[1], b[0] + b[1], c, bits=18)  # <=2^9 digit operands
+    c0 = m_sub(t0, t1, c)
+    c1 = m_fold(t2 + (c.pad[None, :] - (t0 + t1)), c, 13)
+    return c0, c1
+
+
+def m_fq2_sqr(a, c: MC):
+    """(a0+a1)(a0-a1) + 2 a0 a1 u on ss pairs."""
+    d = m_fold(a[0] + (c.pad[None, :] - a[1]), c, 13)  # a0 - a1, ss
+    c0 = m_mul(a[0] + a[1], d, c, bits=17)  # 2^9-incl x 2^8-incl
+    m = m_mul(a[0], a[1], c)
+    return c0, m_fold(m + m, c, 10)
+
+
+# ---------------------------------------------------------------------------
 # kernel bodies (operate on (BLK, ...) refs; all inputs loose <= 2^22)
 # ---------------------------------------------------------------------------
 
@@ -183,62 +354,68 @@ def _norm(x: jnp.ndarray, red: jnp.ndarray) -> jnp.ndarray:
     return _fold50(x, red, 22)
 
 
-def _mul_k(a_ref, b_ref, red_ref, o_ref):
-    red = red_ref[...]
-    o_ref[...] = k_fp_mul(_norm(a_ref[...], red), _norm(b_ref[...], red), red)
+def _mc(refs) -> MC:
+    return MC(*(r[...] for r in refs))
 
 
-def _fq2mul_k(a_ref, b_ref, red_ref, pad_ref, o_ref):
-    red, pad = red_ref[...], pad_ref[...]
-    a = (_norm(a_ref[:, 0, :], red), _norm(a_ref[:, 1, :], red))
-    b = (_norm(b_ref[:, 0, :], red), _norm(b_ref[:, 1, :], red))
-    c = k_fq2_mul(a, b, red, pad)
-    o_ref[:, 0, :] = c[0]
-    o_ref[:, 1, :] = c[1]
+def _mul_k(a_ref, b_ref, *refs):
+    (*crefs, o_ref) = refs
+    c = _mc(crefs)
+    o_ref[...] = m_mul(m_fold(a_ref[...], c), m_fold(b_ref[...], c), c)
 
 
-def _fq2sqr_k(a_ref, red_ref, pad_ref, o_ref, f_ref):
+def _fq2mul_k(a_ref, b_ref, *refs):
+    (*crefs, o_ref) = refs
+    c = _mc(crefs)
+    a = (m_fold(a_ref[:, 0, :], c), m_fold(a_ref[:, 1, :], c))
+    b = (m_fold(b_ref[:, 0, :], c), m_fold(b_ref[:, 1, :], c))
+    r = m_fq2_mul(a, b, c)
+    o_ref[:, 0, :] = r[0]
+    o_ref[:, 1, :] = r[1]
+
+
+def _fq2sqr_k(a_ref, *refs):
     """Fused Fq2 square; ALSO returns the normalized input (free — it is
     computed anyway), which callers use to keep glue bounds small (e.g. the
     cyclotomic-square recombination needs folded copies of its inputs)."""
-    red, pad = red_ref[...], pad_ref[...]
-    a0, a1 = _norm(a_ref[:, 0, :], red), _norm(a_ref[:, 1, :], red)
-    c0 = k_fp_mul(k_fp_add(a0, a1, red), k_fp_sub(a0, a1, red, pad), red)
-    m = k_fp_mul(a0, a1, red)
-    o_ref[:, 0, :] = c0
-    o_ref[:, 1, :] = k_fp_add(m, m, red)
+    (*crefs, o_ref, f_ref) = refs
+    c = _mc(crefs)
+    a0, a1 = m_fold(a_ref[:, 0, :], c), m_fold(a_ref[:, 1, :], c)
+    r = m_fq2_sqr((a0, a1), c)
+    o_ref[:, 0, :] = r[0]
+    o_ref[:, 1, :] = r[1]
     f_ref[:, 0, :] = a0
     f_ref[:, 1, :] = a1
 
 
-def _pow16mul_k(r_ref, t_ref, red_ref, o_ref):
+def _pow16mul_k(r_ref, t_ref, *refs):
     """o = r^16 * t in Fq — the body of every 4-bit-windowed pow scan
-    (Fermat inversion, Legendre chi).  5 schoolbook multiplies, one kernel."""
-    red = red_ref[...]
-    r = _norm(r_ref[...], red)
-    t = _norm(t_ref[...], red)
+    (Fermat inversion, Legendre chi)."""
+    (*crefs, o_ref) = refs
+    c = _mc(crefs)
+    r = m_fold(r_ref[...], c)
+    t = m_fold(t_ref[...], c)
     for _ in range(4):
-        r = k_fp_mul(r, r, red)
-    o_ref[...] = k_fp_mul(r, t, red)
+        r = m_mul(r, r, c)
+    o_ref[...] = m_mul(r, t, c)
 
 
-def _fq2pow16mul_k(r_ref, t_ref, red_ref, pad_ref, o_ref):
-    """o = r^16 * t in Fq2 (4 fused squarings + one Karatsuba = 11
-    schoolbook multiplies — under the Mosaic ceiling)."""
-    red, pad = red_ref[...], pad_ref[...]
-    r = (_norm(r_ref[:, 0, :], red), _norm(r_ref[:, 1, :], red))
-    t = (_norm(t_ref[:, 0, :], red), _norm(t_ref[:, 1, :], red))
+def _fq2pow16mul_k(r_ref, t_ref, *refs):
+    """o = r^16 * t in Fq2 (4 fused squarings + one Karatsuba)."""
+    (*crefs, o_ref) = refs
+    c = _mc(crefs)
+    r = (m_fold(r_ref[:, 0, :], c), m_fold(r_ref[:, 1, :], c))
+    t = (m_fold(t_ref[:, 0, :], c), m_fold(t_ref[:, 1, :], c))
     for _ in range(4):
-        c0 = k_fp_mul(k_fp_add(r[0], r[1], red), k_fp_sub(r[0], r[1], red, pad), red)
-        m = k_fp_mul(r[0], r[1], red)
-        r = (c0, k_fp_add(m, m, red))
-    c = k_fq2_mul(r, t, red, pad)
-    o_ref[:, 0, :] = c[0]
-    o_ref[:, 1, :] = c[1]
+        r = m_fq2_sqr(r, c)
+    rr = m_fq2_mul(r, t, c)
+    o_ref[:, 0, :] = rr[0]
+    o_ref[:, 1, :] = rr[1]
 
 
-def _fold_k(x_ref, red_ref, o_ref):
-    o_ref[...] = _norm(x_ref[...], red_ref[...])
+def _fold_k(x_ref, *refs):
+    (*crefs, o_ref) = refs
+    o_ref[...] = m_fold(x_ref[...], _mc(crefs))
 
 
 # -- canonical reduction (Barrett) ------------------------------------------
@@ -254,15 +431,22 @@ _HOT0_51[0] = 1.0
 def _k_ripple(x: jnp.ndarray, w: int) -> jnp.ndarray:
     """Exact serial carry ripple, statically unrolled (Mosaic-safe: static
     slices, pad+add accumulation — no scatter, no dynamic slicing).
-    x: (B, W<=w) semi-strict-ish digits; returns (B, w) fully-strict."""
-    carry = jnp.zeros((x.shape[0], 1), jnp.float32)
-    out = jnp.zeros((x.shape[0], w), jnp.float32)
+    x: (B, W<=w) semi-strict-ish digits; returns (B, w) fully-strict.
+
+    DIGIT-MAJOR internally: the 51 serial steps each touch one digit; on
+    the natural (B, W) layout that is a (B, 1) column per step — ~B/8
+    sublane tiles of almost-empty vector work, measured ~1 ms per call at
+    2560 rows.  Transposing once to (W, B) makes each step a full-lane
+    row op (~15x cheaper); two transposes amortize over 51 steps."""
+    xt = x.T  # (W, B)
+    carry = jnp.zeros((1, x.shape[0]), jnp.float32)
+    out = jnp.zeros((w, x.shape[0]), jnp.float32)
     for i in range(w):
-        t = carry if i >= x.shape[1] else x[:, i : i + 1] + carry
+        t = carry if i >= x.shape[1] else xt[i : i + 1, :] + carry
         hi = jnp.floor(t * np.float32(1.0 / 256.0))
-        out = out + jnp.pad(t - hi * np.float32(256.0), ((0, 0), (i, w - 1 - i)))
+        out = out + jnp.pad(t - hi * np.float32(256.0), ((i, w - 1 - i), (0, 0)))
         carry = hi
-    return out
+    return out.T
 
 
 def _k_cond_sub(r: jnp.ndarray, c: jnp.ndarray, hot0: jnp.ndarray) -> jnp.ndarray:
@@ -274,15 +458,16 @@ def _k_cond_sub(r: jnp.ndarray, c: jnp.ndarray, hot0: jnp.ndarray) -> jnp.ndarra
     return jnp.where(ge, s[:, :NL], r)
 
 
-def _canon_k(x_ref, red_ref, mu_ref, p48_ref, pc_ref, p2c_ref, hot_ref, o_ref):
+def _canon_k(x_ref, w_ref, f_ref, rep_ref, til_ref, pad_ref, mu_ref, p48_ref, pc_ref, p2c_ref, hot_ref, o_ref):
     """Loose (B, 50) -> canonical residue < p (fully strict digits).
 
     In-kernel port of limbs.fp_reduce_full: fold, exact ripple, Barrett
     quotient via mu = floor(2^424/p), two conditional subtracts.  Replaces
     the three serial lax.scan ripples that sat inside every complete-add
     ladder iteration of the XLA path."""
+    c = MC(w_ref[...], f_ref[...], rep_ref[...], til_ref[...], pad_ref[...])
     mu, hot0 = mu_ref[...], hot_ref[...]
-    x = _k_ripple(_norm(x_ref[...], red_ref[...]), NL + 1)  # strict, 51 digits
+    x = _k_ripple(m_fold(x_ref[...], c), NL + 1)  # strict, 51 digits
     t = x[:, 47:51]
     z = jnp.zeros((x.shape[0], 11), jnp.float32)
     for i in range(4):
@@ -308,9 +493,9 @@ def _canon_k(x_ref, red_ref, mu_ref, p48_ref, pc_ref, p2c_ref, hot_ref, o_ref):
 
 
 # constant operand sets, materialized once (constant-stability rule)
-_CONSTS_RED = (RED,)
-_CONSTS_RED_PAD = (RED, SUBPAD)
-_CONSTS_CANON = (RED, _MU6, _P48, _PC, _P2C, _HOT0_51)
+_CONSTS_RED = _MC_CONSTS
+_CONSTS_RED_PAD = _MC_CONSTS
+_CONSTS_CANON = _MC_CONSTS + (_MU6, _P48, _PC, _P2C, _HOT0_51)
 
 
 def _pcall(kernel, args, consts, out_tail_shapes, interpret):
