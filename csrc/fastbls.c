@@ -1507,6 +1507,152 @@ int fb_aggregate_pubkeys(size_t n, const uint8_t *pks, uint8_t *out96) {
     return FB_OK;
 }
 
+/* ------------------------------------------------------------- signing -- */
+
+/* ZCash compressed encodings (inverse of g1_from_compressed /
+ * g2_from_compressed above): 0x80 = compressed, 0x20 = y lexicographically
+ * greater, 0xC0 = infinity. */
+static void g1_to_compressed(uint8_t *out48, const g1_t *p) {
+    fp_t x, y;
+    if (!g1_to_affine(&x, &y, p)) {
+        memset(out48, 0, 48);
+        out48[0] = 0xC0;
+        return;
+    }
+    fp_to_bytes(out48, &x);
+    out48[0] |= 0x80;
+    if (fp_is_lex_greater(&y)) out48[0] |= 0x20;
+}
+
+static void g2_to_compressed(uint8_t *out96, const g2_t *p) {
+    fp2_t x, y;
+    if (!g2_to_affine(&x, &y, p)) {
+        memset(out96, 0, 96);
+        out96[0] = 0xC0;
+        return;
+    }
+    fp_to_bytes(out96, &x.c1); /* c1 first on the wire */
+    fp_to_bytes(out96 + 48, &x.c0);
+    out96[0] |= 0x80;
+    if (fp2_is_lex_greater(&y)) out96[0] |= 0x20;
+}
+
+/* big-endian 32-byte scalar -> little-endian u64 limbs; returns 0 when the
+ * scalar is 0 or >= r (invalid secret key). */
+static int scalar_from_be32(uint64_t e[4], const uint8_t *sk32) {
+    for (int i = 0; i < 4; i++) {
+        uint64_t v = 0;
+        for (int j = 0; j < 8; j++) v = (v << 8) | sk32[(3 - i) * 8 + j];
+        e[i] = v;
+    }
+    if (!(e[0] | e[1] | e[2] | e[3])) return 0;
+    for (int i = 3; i >= 0; i--) {
+        if (e[i] < FB_ORDER[i]) return 1;
+        if (e[i] > FB_ORDER[i]) return 0;
+    }
+    return 0; /* == r */
+}
+
+/* BLS sign: sig = sk * hash_to_g2(msg), compressed out.  The blst
+ * SecretKey.sign role (reference chain fixtures + validator signing,
+ * @chainsafe/blst bindings) — lets dev chains and test suites skip the
+ * pure-Python G2 ladder (~3 orders of magnitude slower). */
+int fb_sign(uint8_t *out_sig96, const uint8_t *sk32, const uint8_t *msg,
+            size_t msg_len) {
+    uint64_t e[4];
+    if (!scalar_from_be32(e, sk32)) return FB_MALFORMED;
+    g2_t h, s;
+    hash_to_g2(&h, msg, msg_len);
+    g2_mul(&s, &h, e);
+    g2_to_compressed(out_sig96, &s);
+    return FB_OK;
+}
+
+/* aggregate-sign: one signature by the SUM of n secret keys over one
+ * message — equal to aggregating n individual signatures over that message
+ * ((sum sk_i) * H(m) = sum sk_i * H(m)), but pays ONE hash_to_g2 and ONE
+ * scalar mult instead of n of each.  The whole-committee signing shape of
+ * dev chains / sim fixtures (sync aggregates, committee attestations). */
+int fb_sign_aggregate(uint8_t *out_sig96, const uint8_t *sks, size_t n,
+                      const uint8_t *msg, size_t msg_len) {
+    if (n == 0) return FB_MALFORMED;
+    uint64_t acc[4] = {0, 0, 0, 0};
+    for (size_t i = 0; i < n; i++) {
+        uint64_t e[4];
+        if (!scalar_from_be32(e, sks + 32 * i)) return FB_MALFORMED;
+        /* acc = (acc + e) mod r: both < r so the sum < 2r; one conditional
+         * subtract restores the range */
+        unsigned __int128 carry = 0;
+        for (int k = 0; k < 4; k++) {
+            carry += (unsigned __int128)acc[k] + e[k];
+            acc[k] = (uint64_t)carry;
+            carry >>= 64;
+        }
+        int ge = (int)carry;
+        if (!ge) {
+            ge = 1;
+            for (int k = 3; k >= 0; k--) {
+                if (acc[k] < FB_ORDER[k]) { ge = 0; break; }
+                if (acc[k] > FB_ORDER[k]) break;
+            }
+        }
+        if (ge) {
+            unsigned __int128 borrow = 0;
+            for (int k = 0; k < 4; k++) {
+                unsigned __int128 d =
+                    (unsigned __int128)acc[k] - FB_ORDER[k] - (uint64_t)borrow;
+                acc[k] = (uint64_t)d;
+                borrow = (d >> 64) & 1;
+            }
+        }
+    }
+    if (!(acc[0] | acc[1] | acc[2] | acc[3])) return FB_FAIL; /* sum == 0 mod r */
+    g2_t h, s;
+    hash_to_g2(&h, msg, msg_len);
+    g2_mul(&s, &h, acc);
+    g2_to_compressed(out_sig96, &s);
+    return FB_OK;
+}
+
+/* pk = sk * g1, compressed out. */
+int fb_sk_to_pk(uint8_t *out_pk48, const uint8_t *sk32) {
+    uint64_t e[4];
+    if (!scalar_from_be32(e, sk32)) return FB_MALFORMED;
+    g1_t g, p;
+    memcpy(g.x.d, FB_G1_X, sizeof g.x.d);
+    memcpy(g.y.d, FB_G1_Y, sizeof g.y.d);
+    memcpy(g.z.d, FB_R1, sizeof g.z.d);
+    g1_mul(&p, &g, e);
+    g1_to_compressed(out_pk48, &p);
+    return FB_OK;
+}
+
+/* aggregate compressed signatures -> compressed 96-byte aggregate. */
+int fb_aggregate_sigs(size_t n, const uint8_t *sigs, uint8_t *out96) {
+    g2_t acc;
+    g2_infinity(&acc);
+    for (size_t i = 0; i < n; i++) {
+        g2_t p;
+        if (!g2_from_compressed(&p, sigs + 96 * i)) return FB_MALFORMED;
+        g2_add(&acc, &acc, &p);
+    }
+    g2_to_compressed(out96, &acc);
+    return FB_OK;
+}
+
+/* aggregate compressed pubkeys -> compressed 48-byte aggregate. */
+int fb_aggregate_pubkeys_c(size_t n, const uint8_t *pks, uint8_t *out48) {
+    g1_t acc;
+    g1_infinity(&acc);
+    for (size_t i = 0; i < n; i++) {
+        g1_t p;
+        if (!g1_from_compressed(&p, pks + 48 * i)) return FB_MALFORMED;
+        g1_add(&acc, &acc, &p);
+    }
+    g1_to_compressed(out48, &acc);
+    return FB_OK;
+}
+
 /* self-test: e(g1, g2) is non-one, bilinearity e([2]g1, g2) == e(g1, [2]g2),
  * and sha256("") matches the known digest. */
 int fb_selftest(void) {

@@ -230,6 +230,8 @@ class RestApiServer:
         r("POST", "/eth/v1/validator/contribution_and_proofs", self._submit_contributions)
         r("GET", "/eth/v1/beacon/light_client/bootstrap/{block_root}", self._lc_bootstrap)
         r("GET", "/eth/v1/beacon/light_client/updates", self._lc_updates)
+        r("GET", "/eth/v1/beacon/light_client/finality_update", self._lc_finality_update)
+        r("GET", "/eth/v1/beacon/light_client/optimistic_update", self._lc_optimistic_update)
         # debug namespace (routes/debug.ts): SSZ state download — the
         # checkpoint-sync server side (initBeaconState.ts fetches this)
         r("GET", "/eth/v2/debug/beacon/states/{state_id}", self._debug_state)
@@ -452,6 +454,21 @@ class RestApiServer:
         if "finalized_checkpoint" in wanted:
             chain.emitter.on(ChainEvent.FINALIZED, on_finalized)
             subs.append((ChainEvent.FINALIZED, on_finalized))
+
+        # light-client SSE topics (routes/events.ts eventTypes
+        # light_client_finality_update / light_client_optimistic_update)
+        def on_lc_finality(update) -> None:
+            _put("light_client_finality_update", to_json(update))
+
+        def on_lc_optimistic(update) -> None:
+            _put("light_client_optimistic_update", to_json(update))
+
+        if "light_client_finality_update" in wanted:
+            chain.emitter.on(ChainEvent.LIGHT_CLIENT_FINALITY_UPDATE, on_lc_finality)
+            subs.append((ChainEvent.LIGHT_CLIENT_FINALITY_UPDATE, on_lc_finality))
+        if "light_client_optimistic_update" in wanted:
+            chain.emitter.on(ChainEvent.LIGHT_CLIENT_OPTIMISTIC_UPDATE, on_lc_optimistic)
+            subs.append((ChainEvent.LIGHT_CLIENT_OPTIMISTIC_UPDATE, on_lc_optimistic))
 
         async def stream():
             try:
@@ -932,6 +949,28 @@ class RestApiServer:
             if u is not None:
                 out.append(to_json(u))
         return {"data": out}
+
+    def _lc_finality_update(self, pp, q, b):
+        """Latest finality update (routes/lightclient.ts:60
+        getLightClientFinalityUpdate)."""
+        lc = getattr(self, "light_client_server", None)
+        if lc is None:
+            raise ApiError(404, "light client server not enabled")
+        u = lc.get_finality_update()
+        if u is None:
+            raise ApiError(404, "no finality update available")
+        return {"data": to_json(u)}
+
+    def _lc_optimistic_update(self, pp, q, b):
+        """Latest optimistic (head) update (routes/lightclient.ts:60
+        getLightClientOptimisticUpdate)."""
+        lc = getattr(self, "light_client_server", None)
+        if lc is None:
+            raise ApiError(404, "light client server not enabled")
+        u = lc.get_optimistic_update()
+        if u is None:
+            raise ApiError(404, "no optimistic update available")
+        return {"data": to_json(u)}
 
     def _metrics(self, pp, q, b):
         if self.metrics_registry is None:

@@ -20,6 +20,8 @@ class ChainEvent(str, enum.Enum):
     FINALIZED = "finalized"
     HEAD = "forkChoice:head"
     REORG = "forkChoice:reorg"
+    LIGHT_CLIENT_FINALITY_UPDATE = "lightClient:finalityUpdate"
+    LIGHT_CLIENT_OPTIMISTIC_UPDATE = "lightClient:optimisticUpdate"
 
 
 class ChainEventEmitter:

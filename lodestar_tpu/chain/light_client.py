@@ -47,7 +47,11 @@ class LightClientServer:
         self.chain = chain
         self.t = get_types(preset)
         self.best_update_by_period: Dict[int, object] = {}
-        chain.emitter.on_block(self._on_block) if hasattr(chain.emitter, "on_block") else None
+        # latest head/finality updates (reference lightClient/index.ts:198
+        # onImportBlockHead keeps latestHeadUpdate + finalized variant and
+        # emits lightClientOptimisticUpdate / lightClientFinalityUpdate)
+        self.latest_optimistic_update = None
+        self.latest_finality_update = None
         from .emitter import ChainEvent
 
         chain.emitter.on(ChainEvent.BLOCK, self._on_block)
@@ -86,22 +90,92 @@ class LightClientServer:
         attested_state = self.chain.get_state_by_block_root(attested_root)
         if attested_block is None or attested_state is None:
             return
+        self._track_head_updates(block, attested_block, attested_state, agg)
         period = sync_period_at_slot(self.p, attested_block.message.slot)
+        # "relevant": signed within the attested header's own period, so a
+        # store whose next committee is still unknown can verify it (spec
+        # is_better_update's sync-committee-relevance criterion) — an update
+        # attesting the LAST slot of a period is signed by the NEXT period's
+        # committee and must lose to any same-period-signed candidate
+        new_rel = sync_period_at_slot(self.p, block.slot) == period
         cur = self.best_update_by_period.get(period)
         if cur is not None:
+            cur_rel = sync_period_at_slot(self.p, cur.signature_slot) == period
+            if cur_rel and not new_rel:
+                return
             cur_part = sum(cur.sync_aggregate.sync_committee_bits)
-            # isBetterUpdate: more participation wins; on a tie prefer the
-            # newer attested header (fresher finality info)
-            if cur_part > participation or (
-                cur_part == participation
-                and cur.attested_header.slot >= attested_block.message.slot
+            # same relevance class: more participation wins; on a tie
+            # prefer the newer attested header (fresher finality info)
+            if cur_rel == new_rel and (
+                cur_part > participation
+                or (
+                    cur_part == participation
+                    and cur.attested_header.slot >= attested_block.message.slot
+                )
             ):
                 return
-        update = self._build_update(attested_block, attested_state, agg)
+        update = self._build_update(attested_block, attested_state, agg,
+                                    signature_slot=block.slot)
         if update is not None:
             self.best_update_by_period[period] = update
 
-    def _build_update(self, attested_block, attested_state, sync_aggregate):
+    def _track_head_updates(self, block, attested_block, attested_state, agg) -> None:
+        """Maintain latest optimistic + finality updates and emit events
+        (reference lightClient/index.ts:198 onImportBlockHead; routes
+        lightclient.ts:60 getLightClientOptimisticUpdate /
+        getLightClientFinalityUpdate)."""
+        from .emitter import ChainEvent
+
+        attested_slot = attested_block.message.slot
+        participation = sum(agg.sync_committee_bits)
+        cur = self.latest_optimistic_update
+        # newer attested header wins; same header needs more participation
+        if cur is None or attested_slot > cur.attested_header.slot or (
+            attested_slot == cur.attested_header.slot
+            and participation > sum(cur.sync_aggregate.sync_committee_bits)
+        ):
+            ou = Fields(
+                attested_header=block_to_header(self.p, attested_block.message),
+                sync_aggregate=agg,
+                signature_slot=block.slot,
+            )
+            self.latest_optimistic_update = ou
+            self.chain.emitter.emit(ChainEvent.LIGHT_CLIENT_OPTIMISTIC_UPDATE, ou)
+
+        fin_cp = attested_state.finalized_checkpoint
+        if bytes(fin_cp.root) == b"\x00" * 32:
+            return
+        fin_block = self.chain.get_block_by_root(bytes(fin_cp.root))
+        if fin_block is None:
+            return
+        cur = self.latest_finality_update
+        if cur is not None and not (
+            attested_slot > cur.attested_header.slot or (
+                attested_slot == cur.attested_header.slot
+                and participation > sum(cur.sync_aggregate.sync_committee_bits)
+            )
+        ):
+            return
+        from ..state_transition.upgrade import state_types
+        from ..ssz import uint64 as u64t
+
+        st = state_types(self.p, attested_state).BeaconState
+        _, state_branch = st.get_field_proof(attested_state, "finalized_checkpoint")
+        finality_branch = [u64t.hash_tree_root(fin_cp.epoch)] + [
+            bytes(b) for b in state_branch
+        ]
+        fu = Fields(
+            attested_header=block_to_header(self.p, attested_block.message),
+            finalized_header=block_to_header(self.p, fin_block.message),
+            finality_branch=finality_branch,
+            sync_aggregate=agg,
+            signature_slot=block.slot,
+        )
+        self.latest_finality_update = fu
+        self.chain.emitter.emit(ChainEvent.LIGHT_CLIENT_FINALITY_UPDATE, fu)
+
+    def _build_update(self, attested_block, attested_state, sync_aggregate,
+                      signature_slot: int = 0):
         from ..state_transition.upgrade import state_types
 
         st = state_types(self.p, attested_state).BeaconState
@@ -135,6 +209,7 @@ class LightClientServer:
             finalized_header=finalized_header or empty_header,
             finality_branch=finality_branch,
             sync_aggregate=sync_aggregate,
+            signature_slot=signature_slot or (attested_block.message.slot + 1),
             fork_version=bytes(attested_state.fork.current_version),
         )
 
@@ -145,3 +220,9 @@ class LightClientServer:
         if not self.best_update_by_period:
             return None
         return self.best_update_by_period[max(self.best_update_by_period)]
+
+    def get_finality_update(self):
+        return self.latest_finality_update
+
+    def get_optimistic_update(self):
+        return self.latest_optimistic_update

@@ -35,6 +35,7 @@ from .curve import (
 from .fields import Fq, Fq2, R
 from .hash_to_curve import DST_G2, hash_to_g2
 from .pairing import multi_pairing
+from ...native import fastbls as _native
 
 
 class SecretKey:
@@ -55,58 +56,118 @@ class SecretKey:
         return self.value.to_bytes(32, "big")
 
     def to_public_key(self) -> "PublicKey":
+        raw = _native.sk_to_pk(self.to_bytes())
+        if raw is not None:
+            return PublicKey(raw=raw)
         return PublicKey(G1_GEN * self.value)
 
     def sign(self, msg: bytes) -> "Signature":
+        # native path (fb_sign): identical compressed bytes, ~3 orders of
+        # magnitude faster than the bigint G2 ladder; differential test
+        # pins byte equality (tests/test_native_sign.py)
+        raw = _native.sign(self.to_bytes(), msg)
+        if raw is not None:
+            return Signature(raw=raw)
         return Signature(hash_to_g2(msg) * self.value)
 
 
 class PublicKey:
-    __slots__ = ("point",)
+    """Lazily materialised: freshly-derived keys carry only their canonical
+    compressed bytes (the native fb_sk_to_pk output) and decompress on first
+    curve use, so serialize-only flows never pay Python field math."""
 
-    def __init__(self, point: Point[Fq]):
-        self.point = point
+    __slots__ = ("_point", "_raw")
+
+    def __init__(self, point: Optional[Point[Fq]] = None, raw: Optional[bytes] = None):
+        if point is None and raw is None:
+            raise ValueError("PublicKey needs a point or raw bytes")
+        self._point = point
+        self._raw = raw
+
+    @property
+    def point(self) -> Point[Fq]:
+        if self._point is None:
+            # self-produced canonical bytes: skip the subgroup check
+            self._point = g1_from_bytes(self._raw, subgroup_check=False)
+        return self._point
 
     @classmethod
     def from_bytes(cls, data: bytes, validate: bool = True) -> "PublicKey":
-        return cls(g1_from_bytes(data, subgroup_check=validate))
+        pk = cls(g1_from_bytes(data, subgroup_check=validate))
+        pk._raw = bytes(data)
+        return pk
 
     def to_bytes(self) -> bytes:
-        return g1_to_bytes(self.point)
+        if self._raw is None:
+            self._raw = g1_to_bytes(self._point)
+        return self._raw
 
     def is_infinity(self) -> bool:
-        return self.point.is_infinity()
+        if self._point is not None:
+            return self._point.is_infinity()
+        return self._raw[0] == 0xC0 and not any(self._raw[1:])
 
     def __eq__(self, other: object) -> bool:
-        return isinstance(other, PublicKey) and self.point == other.point
+        return isinstance(other, PublicKey) and self.to_bytes() == other.to_bytes()
 
     def __hash__(self) -> int:
-        return hash(("PublicKey", self.point))
+        return hash(("PublicKey", self.to_bytes()))
 
 
 class Signature:
-    __slots__ = ("point",)
+    """Lazily materialised like PublicKey: native-signed signatures carry
+    compressed bytes only until a pairing needs the actual point."""
 
-    def __init__(self, point: Point[Fq2]):
-        self.point = point
+    __slots__ = ("_point", "_raw")
+
+    def __init__(self, point: Optional[Point[Fq2]] = None, raw: Optional[bytes] = None):
+        if point is None and raw is None:
+            raise ValueError("Signature needs a point or raw bytes")
+        self._point = point
+        self._raw = raw
+
+    @property
+    def point(self) -> Point[Fq2]:
+        if self._point is None:
+            self._point = g2_from_bytes(self._raw, subgroup_check=False)
+        return self._point
 
     @classmethod
     def from_bytes(cls, data: bytes, validate: bool = True) -> "Signature":
-        return cls(g2_from_bytes(data, subgroup_check=validate))
+        sig = cls(g2_from_bytes(data, subgroup_check=validate))
+        sig._raw = bytes(data)
+        return sig
 
     def to_bytes(self) -> bytes:
-        return g2_to_bytes(self.point)
+        if self._raw is None:
+            self._raw = g2_to_bytes(self._point)
+        return self._raw
 
     def __eq__(self, other: object) -> bool:
-        return isinstance(other, Signature) and self.point == other.point
+        return isinstance(other, Signature) and self.to_bytes() == other.to_bytes()
 
     def __hash__(self) -> int:
-        return hash(("Signature", self.point))
+        return hash(("Signature", self.to_bytes()))
+
+
+def sign_aggregate(sks: Sequence[SecretKey], msg: bytes) -> "Signature":
+    """Aggregate signature of the same message by many keys — one hash +
+    one scalar mult on the native path (fb_sign_aggregate); per-key sign +
+    aggregate otherwise.  The whole-committee signing shape."""
+    raw = _native.sign_aggregate([sk.to_bytes() for sk in sks], msg)
+    if raw is not None:
+        return Signature(raw=raw)
+    return aggregate_signatures([sk.sign(msg) for sk in sks])
 
 
 def aggregate_pubkeys(pubkeys: Sequence[PublicKey]) -> PublicKey:
     """Sum in jacobian coords (reference: getAggregatedPubkey,
-    chain/bls/utils.ts:5, ~3x faster than affine per interface.ts:31-33)."""
+    chain/bls/utils.ts:5, ~3x faster than affine per interface.ts:31-33).
+    All-raw inputs aggregate natively (fb_aggregate_pubkeys_c)."""
+    if pubkeys and all(pk._raw is not None and pk._point is None for pk in pubkeys):
+        out = _native.aggregate_pks([pk._raw for pk in pubkeys])
+        if out is not None:
+            return PublicKey(raw=out)
     acc: Point[Fq] = Point.infinity(B1)
     for pk in pubkeys:
         acc = acc + pk.point
@@ -114,6 +175,10 @@ def aggregate_pubkeys(pubkeys: Sequence[PublicKey]) -> PublicKey:
 
 
 def aggregate_signatures(sigs: Sequence[Signature]) -> Signature:
+    if sigs and all(s._raw is not None and s._point is None for s in sigs):
+        out = _native.aggregate_sigs([s._raw for s in sigs])
+        if out is not None:
+            return Signature(raw=out)
     acc: Point[Fq2] = Point.infinity(B2)
     for s in sigs:
         acc = acc + s.point

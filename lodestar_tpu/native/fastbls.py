@@ -70,6 +70,25 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.fb_final_exp_is_one.argtypes = [ctypes.c_char_p]
             lib.fb_hash_to_g2.restype = ctypes.c_int
             lib.fb_hash_to_g2.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t]
+            lib.fb_sign.restype = ctypes.c_int
+            lib.fb_sign.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.fb_sk_to_pk.restype = ctypes.c_int
+            lib.fb_sk_to_pk.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+            lib.fb_sign_aggregate.restype = ctypes.c_int
+            lib.fb_sign_aggregate.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.fb_aggregate_sigs.restype = ctypes.c_int
+            lib.fb_aggregate_sigs.argtypes = [
+                ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p,
+            ]
+            lib.fb_aggregate_pubkeys_c.restype = ctypes.c_int
+            lib.fb_aggregate_pubkeys_c.argtypes = [
+                ctypes.c_size_t, ctypes.c_char_p, ctypes.c_char_p,
+            ]
             if lib.fb_selftest() != 1:
                 return None
             _LIB = lib
@@ -114,6 +133,73 @@ def final_exp_is_one(f_bytes: bytes) -> Optional[bool]:
     if len(f_bytes) != 576:
         return False
     return lib.fb_final_exp_is_one(f_bytes) == 1
+
+
+def sign(sk32: bytes, msg: bytes) -> Optional[bytes]:
+    """sk * H(msg) as a compressed 96-byte G2 signature (fb_sign); None
+    without the native lib or for an invalid scalar."""
+    lib = _load()
+    if lib is None or len(sk32) != 32:
+        return None
+    out = ctypes.create_string_buffer(96)
+    if lib.fb_sign(out, sk32, msg, len(msg)) != 1:
+        return None
+    return out.raw
+
+
+def sign_aggregate(sks: Sequence[bytes], msg: bytes) -> Optional[bytes]:
+    """One aggregate signature by n secret keys over one message — equals
+    aggregating n individual signatures but pays one hash + one scalar mult
+    (fb_sign_aggregate)."""
+    lib = _load()
+    if lib is None or not sks:
+        return None
+    blob = b"".join(sks)
+    if len(blob) != 32 * len(sks):
+        return None
+    out = ctypes.create_string_buffer(96)
+    if lib.fb_sign_aggregate(out, blob, len(sks), msg, len(msg)) != 1:
+        return None
+    return out.raw
+
+
+def sk_to_pk(sk32: bytes) -> Optional[bytes]:
+    """sk * g1 as a compressed 48-byte pubkey (fb_sk_to_pk)."""
+    lib = _load()
+    if lib is None or len(sk32) != 32:
+        return None
+    out = ctypes.create_string_buffer(48)
+    if lib.fb_sk_to_pk(out, sk32) != 1:
+        return None
+    return out.raw
+
+
+def aggregate_sigs(sigs: Sequence[bytes]) -> Optional[bytes]:
+    """Sum of compressed signatures, compressed out (fb_aggregate_sigs)."""
+    lib = _load()
+    if lib is None:
+        return None
+    blob = b"".join(sigs)
+    if len(blob) != 96 * len(sigs):
+        return None
+    out = ctypes.create_string_buffer(96)
+    if lib.fb_aggregate_sigs(len(sigs), blob, out) != 1:
+        return None
+    return out.raw
+
+
+def aggregate_pks(pks: Sequence[bytes]) -> Optional[bytes]:
+    """Sum of compressed pubkeys, compressed out (fb_aggregate_pubkeys_c)."""
+    lib = _load()
+    if lib is None:
+        return None
+    blob = b"".join(pks)
+    if len(blob) != 48 * len(pks):
+        return None
+    out = ctypes.create_string_buffer(48)
+    if lib.fb_aggregate_pubkeys_c(len(pks), blob, out) != 1:
+        return None
+    return out.raw
 
 
 def hash_to_g2_affine(msg: bytes) -> Optional[Tuple[int, int, int, int]]:

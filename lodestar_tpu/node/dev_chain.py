@@ -17,7 +17,7 @@ from ..chain.beacon_chain import BeaconChain
 from ..chain.bls_pool import BlsBatchPool
 from ..chain.clock import LocalClock, ManualClock
 from ..config.chain_config import ChainConfig
-from ..crypto.bls.api import SecretKey, aggregate_signatures, interop_secret_key
+from ..crypto.bls.api import SecretKey, interop_secret_key, sign_aggregate
 from ..params import (
     DOMAIN_BEACON_ATTESTER,
     DOMAIN_BEACON_PROPOSER,
@@ -103,7 +103,7 @@ class DevChain:
             return None
         pk2i = {bytes(interop_pubkey): i for i, interop_pubkey in self._pubkey_by_index().items()}
         root = sync_aggregate_signing_root(self.p, pre)
-        sigs = []
+        signers = []
         bits = []
         for pk in pre.current_sync_committee.pubkeys:
             idx = pk2i.get(bytes(pk))
@@ -111,12 +111,12 @@ class DevChain:
                 bits.append(False)
                 continue
             bits.append(True)
-            sigs.append(self.keys[idx].sign(root))
+            signers.append(self.keys[idx])
         if not any(bits):
             return None
         return Fields(
             sync_committee_bits=bits,
-            sync_committee_signature=aggregate_signatures(sigs).to_bytes(),
+            sync_committee_signature=sign_aggregate(signers, root).to_bytes(),
         )
 
     def _pubkey_by_index(self) -> Dict[int, bytes]:
@@ -148,11 +148,11 @@ class DevChain:
                 target=Fields(epoch=epoch, root=target_root),
             )
             root = compute_signing_root(self.p, self.t.AttestationData, data, domain)
-            sigs = [self.keys[int(vi)].sign(root) for vi in committee]
+            agg_sig = sign_aggregate([self.keys[int(vi)] for vi in committee], root)
             att = Fields(
                 aggregation_bits=[True] * len(committee),
                 data=data,
-                signature=aggregate_signatures(sigs).to_bytes(),
+                signature=agg_sig.to_bytes(),
             )
             self.pending_attestations.append(att)
 

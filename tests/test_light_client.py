@@ -11,10 +11,11 @@ import pytest
 from lodestar_tpu.chain.bls_pool import BlsBatchPool
 from lodestar_tpu.chain.light_client import LightClientServer, block_to_header
 from lodestar_tpu.config.chain_config import ChainConfig
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
 from lodestar_tpu.light_client import LightClient, LightClientError
 from lodestar_tpu.node.dev_chain import DevChain
 from lodestar_tpu.params import MINIMAL
+from lodestar_tpu.ssz import Fields
 from lodestar_tpu.types import get_types
 
 CFG = ChainConfig(
@@ -27,7 +28,7 @@ N = 16
 
 def test_light_client_follows_finality():
     async def main():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         dev = DevChain(MINIMAL, CFG, N, pool)
         server = LightClientServer(MINIMAL, dev.chain)
 
@@ -75,7 +76,7 @@ def test_light_client_over_rest_api():
         from lodestar_tpu.api import ApiClient, RestApiServer
         from lodestar_tpu.api.serde import from_json
 
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         dev = DevChain(MINIMAL, CFG, N, pool)
         server = LightClientServer(MINIMAL, dev.chain)
         await dev.run(5 * MINIMAL.SLOTS_PER_EPOCH + 2)
@@ -98,7 +99,84 @@ def test_light_client_over_rest_api():
             lc.process_update(from_json(u))
         assert lc.finalized_header.slot > 0
 
+        # head-following routes (routes/lightclient.ts:60): the latest
+        # finality + optimistic updates are served and process cleanly
+        fu = await api.get("/eth/v1/beacon/light_client/finality_update")
+        lc.process_finality_update(from_json(fu["data"]))
+        assert lc.finalized_header.slot >= from_json(fu["data"]).finalized_header.slot
+        ou = await api.get("/eth/v1/beacon/light_client/optimistic_update")
+        lc.process_optimistic_update(from_json(ou["data"]))
+        assert lc.optimistic_header.slot >= from_json(ou["data"]).attested_header.slot
+
         await rest.close()
+        pool.close()
+
+    asyncio.run(main())
+
+
+def test_light_client_two_period_gap_and_forced_advance():
+    """The client crosses TWO sync-committee periods via the per-period
+    update ladder, and a second client stuck without finality advances by
+    force_update (spec process_light_client_store_force_update; reference
+    light-client/src/index.ts:110 forced committee advance)."""
+
+    async def main():
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
+        dev = DevChain(MINIMAL, CFG, N, pool)
+        server = LightClientServer(MINIMAL, dev.chain)
+        slots_per_period = (
+            MINIMAL.SLOTS_PER_EPOCH * MINIMAL.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+        )
+        # take the bootstrap while its block + state are still hot, then run
+        # the chain into period 2 so the ladder must rotate committees twice
+        await dev.run(2 * MINIMAL.SLOTS_PER_EPOCH + 2)
+        chain = dev.chain
+        boot_root = chain.fork_choice.get_ancestor(
+            chain.head_root, MINIMAL.SLOTS_PER_EPOCH + 1
+        )
+        bootstrap = server.get_bootstrap(boot_root)
+        assert bootstrap is not None
+        await dev.run(2 * slots_per_period)
+        gvr = bytes(chain.genesis_state.genesis_validators_root)
+
+        # --- ladder client: periods 0 -> 1 -> 2 ---------------------------
+        lc = LightClient(MINIMAL, CFG, bootstrap, gvr)
+        for period in sorted(server.best_update_by_period):
+            lc.process_update(server.get_update(period))
+        fin_period = lc._sync_period(lc.finalized_header.slot)
+        assert fin_period >= 1, f"ladder stalled at period {fin_period}"
+        # the head-following tail catches up to the chain head
+        fu = server.get_finality_update()
+        assert fu is not None
+        lc.process_finality_update(fu)
+        assert lc._sync_period(lc.finalized_header.slot) == 2
+        assert lc.optimistic_header.slot > 2 * slots_per_period
+
+        # --- forced-advance client: finality withheld ---------------------
+        lc2 = LightClient(MINIMAL, CFG, bootstrap, gvr)
+        u0 = server.get_update(0)
+        stripped = Fields(**{k: u0[k] for k in u0.keys()})
+        stripped.finalized_header = Fields(
+            slot=0, proposer_index=0, parent_root=b"\x00" * 32,
+            state_root=b"\x00" * 32, body_root=b"\x00" * 32,
+        )
+        lc2.process_update(stripped)
+        assert lc2.finalized_header.slot == bootstrap.header.slot, (
+            "no-finality update must not advance the finalized header"
+        )
+        assert lc2.best_valid_update is not None
+        # before the timeout nothing happens
+        assert not lc2.force_update(bootstrap.header.slot + MINIMAL.UPDATE_TIMEOUT)
+        # past it, the candidate's attested header is promoted
+        assert lc2.force_update(
+            bootstrap.header.slot + MINIMAL.UPDATE_TIMEOUT + 1
+        )
+        assert lc2.finalized_header.slot > bootstrap.header.slot
+        assert lc2.next_sync_committee is not None
+        # and the ladder continues normally from there
+        lc2.process_update(server.get_update(1))
+        assert lc2._sync_period(lc2.finalized_header.slot) >= 1
+
         pool.close()
 
     asyncio.run(main())

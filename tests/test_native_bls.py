@@ -135,3 +135,69 @@ def test_batch_verify_agreement_with_oracle_batcher():
     coeffs = [secrets.randbits(64) | 1 for _ in packed]
     assert verify_multiple_signatures(triples) is True
     assert fastbls.batch_verify(packed, coeffs) is True
+
+
+def test_native_sign_matches_oracle_bytes():
+    """fb_sign produces byte-identical compressed signatures to the bigint
+    ladder; fb_sk_to_pk byte-identical pubkeys — the lazy Signature path in
+    api.py depends on this equality."""
+    for i in range(4):
+        sk = interop_secret_key(i)
+        msg = bytes([i]) * 32
+        native = fastbls.sign(sk.to_bytes(), msg)
+        oracle = C.g2_to_bytes(hash_to_g2(msg) * sk.value)
+        assert native == oracle
+        assert fastbls.sk_to_pk(sk.to_bytes()) == C.g1_to_bytes(C.G1_GEN * sk.value)
+
+
+def test_native_sign_rejects_invalid_scalars():
+    from lodestar_tpu.crypto.bls.fields import R
+
+    assert fastbls.sign(b"\x00" * 32, b"m" * 32) is None           # zero
+    assert fastbls.sign(R.to_bytes(32, "big"), b"m" * 32) is None  # == r
+    assert fastbls.sign((R + 1).to_bytes(32, "big"), b"m" * 32) is None
+
+
+def test_native_sign_aggregate_matches_per_key():
+    """fb_sign_aggregate((sum sk)·H) == aggregate of individual signatures —
+    the whole-committee shape used by DevChain fixtures."""
+    sks = [interop_secret_key(i) for i in range(8)]
+    msg = b"\x42" * 32
+    fast = fastbls.sign_aggregate([sk.to_bytes() for sk in sks], msg)
+    acc = None
+    for sk in sks:
+        pt = hash_to_g2(msg) * sk.value
+        acc = pt if acc is None else acc + pt
+    assert fast == C.g2_to_bytes(acc)
+
+
+def test_native_aggregate_sigs_and_pks():
+    sks = [interop_secret_key(i) for i in range(5)]
+    msg = b"\x17" * 32
+    sig_bytes = [C.g2_to_bytes(hash_to_g2(msg) * sk.value) for sk in sks]
+    pk_bytes = [C.g1_to_bytes(C.G1_GEN * sk.value) for sk in sks]
+    agg_sig = fastbls.aggregate_sigs(sig_bytes)
+    agg_pk = fastbls.aggregate_pks(pk_bytes)
+    acc_s = None
+    acc_p = None
+    for sk in sks:
+        s = hash_to_g2(msg) * sk.value
+        p = C.G1_GEN * sk.value
+        acc_s = s if acc_s is None else acc_s + s
+        acc_p = p if acc_p is None else acc_p + p
+    assert agg_sig == C.g2_to_bytes(acc_s)
+    assert agg_pk == C.g1_to_bytes(acc_p)
+
+
+def test_lazy_signature_roundtrip_and_equality():
+    """Signature/PublicKey lazy-bytes objects interoperate with point-backed
+    ones: equality, hashing, decompression on demand."""
+    sk = interop_secret_key(3)
+    msg = b"\x55" * 32
+    lazy = sk.sign(msg)                      # native raw-backed
+    eager = Signature(hash_to_g2(msg) * sk.value)
+    assert lazy == eager and hash(lazy) == hash(eager)
+    assert lazy.point == eager.point         # decompression on demand
+    pk_lazy = sk.to_public_key()
+    pk_eager = PublicKey(C.G1_GEN * sk.value)
+    assert pk_lazy == pk_eager and not pk_lazy.is_infinity()
