@@ -35,6 +35,9 @@ class ChainEventEmitter:
         if handler in self._handlers[event]:
             self._handlers[event].remove(handler)
 
+    def has_listeners(self, event: ChainEvent) -> bool:
+        return bool(self._handlers.get(event))
+
     def emit(self, event: ChainEvent, *args) -> None:
         for handler in list(self._handlers[event]):
             handler(*args)

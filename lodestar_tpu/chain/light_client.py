@@ -52,6 +52,10 @@ class LightClientServer:
         # emits lightClientOptimisticUpdate / lightClientFinalityUpdate)
         self.latest_optimistic_update = None
         self.latest_finality_update = None
+        # parked (attested_block, attested_state, agg, signature_slot) whose
+        # finality proof hasn't been materialised yet (see
+        # _track_head_updates on why this is lazy)
+        self._pending_finality = None
         from .emitter import ChainEvent
 
         chain.emitter.on(ChainEvent.BLOCK, self._on_block)
@@ -92,38 +96,72 @@ class LightClientServer:
             return
         self._track_head_updates(block, attested_block, attested_state, agg)
         period = sync_period_at_slot(self.p, attested_block.message.slot)
-        # "relevant": signed within the attested header's own period, so a
-        # store whose next committee is still unknown can verify it (spec
-        # is_better_update's sync-committee-relevance criterion) — an update
-        # attesting the LAST slot of a period is signed by the NEXT period's
-        # committee and must lose to any same-period-signed candidate
+        # spec is_better_update cascade, computed without building the
+        # update: supermajority first, then participation below it, then
+        # relevance ("relevant" = signed within the attested header's own
+        # period, so a store whose next committee is still unknown can
+        # verify it — an update attesting the LAST slot of a period is
+        # signed by the NEXT period's committee), then participation, then
+        # the fresher attested header (newer finality info)
         new_rel = sync_period_at_slot(self.p, block.slot) == period
         cur = self.best_update_by_period.get(period)
         if cur is not None:
-            cur_rel = sync_period_at_slot(self.p, cur.signature_slot) == period
-            if cur_rel and not new_rel:
-                return
+            max_bits = len(agg.sync_committee_bits)
             cur_part = sum(cur.sync_aggregate.sync_committee_bits)
-            # same relevance class: more participation wins; on a tie
-            # prefer the newer attested header (fresher finality info)
-            if cur_rel == new_rel and (
-                cur_part > participation
-                or (
-                    cur_part == participation
-                    and cur.attested_header.slot >= attested_block.message.slot
-                )
-            ):
+            cur_rel = sync_period_at_slot(self.p, cur.signature_slot) == period
+            new_sup = participation * 3 >= max_bits * 2
+            cur_sup = cur_part * 3 >= max_bits * 2
+            if new_sup != cur_sup:
+                better = new_sup
+            elif not new_sup and participation != cur_part:
+                better = participation > cur_part
+            elif new_rel != cur_rel:
+                better = new_rel
+            elif participation != cur_part:
+                better = participation > cur_part
+            else:
+                better = attested_block.message.slot > cur.attested_header.slot
+            if not better:
                 return
         update = self._build_update(attested_block, attested_state, agg,
                                     signature_slot=block.slot)
         if update is not None:
             self.best_update_by_period[period] = update
 
+    def _finality_proof(self, attested_state):
+        """(finalized_header, finality_branch) for an attested state, or
+        (None, None) when it has no finality — the ONE implementation of
+        the Checkpoint generalized-index layout ([htr(epoch)] + the
+        finalized_checkpoint state branch) that both the per-period updates
+        and the head finality updates serve, mirrored by the client's
+        idx = 1 + 2*field_index('finalized_checkpoint') verification."""
+        from ..state_transition.upgrade import state_types
+        from ..ssz import uint64 as u64t
+
+        fin_cp = attested_state.finalized_checkpoint
+        if bytes(fin_cp.root) == b"\x00" * 32:
+            return None, None
+        fin_block = self.chain.get_block_by_root(bytes(fin_cp.root))
+        if fin_block is None:
+            return None, None
+        st = state_types(self.p, attested_state).BeaconState
+        _, state_branch = st.get_field_proof(attested_state, "finalized_checkpoint")
+        finality_branch = [u64t.hash_tree_root(fin_cp.epoch)] + [
+            bytes(b) for b in state_branch
+        ]
+        return block_to_header(self.p, fin_block.message), finality_branch
+
     def _track_head_updates(self, block, attested_block, attested_state, agg) -> None:
         """Maintain latest optimistic + finality updates and emit events
         (reference lightClient/index.ts:198 onImportBlockHead; routes
         lightclient.ts:60 getLightClientOptimisticUpdate /
-        getLightClientFinalityUpdate)."""
+        getLightClientFinalityUpdate).
+
+        The finality update's merkle proof costs a partial state
+        re-merkleization (~300 ms at 250k validators on a fresh state), so
+        it is built LAZILY: the candidate block/state are parked and the
+        proof is materialised on first demand (REST route or SSE
+        subscriber) — block import never pays for it."""
         from .emitter import ChainEvent
 
         attested_slot = attested_block.message.slot
@@ -142,37 +180,30 @@ class LightClientServer:
             self.latest_optimistic_update = ou
             self.chain.emitter.emit(ChainEvent.LIGHT_CLIENT_OPTIMISTIC_UPDATE, ou)
 
-        fin_cp = attested_state.finalized_checkpoint
-        if bytes(fin_cp.root) == b"\x00" * 32:
-            return
-        fin_block = self.chain.get_block_by_root(bytes(fin_cp.root))
-        if fin_block is None:
+        if bytes(attested_state.finalized_checkpoint.root) == b"\x00" * 32:
             return
         cur = self.latest_finality_update
-        if cur is not None and not (
-            attested_slot > cur.attested_header.slot or (
-                attested_slot == cur.attested_header.slot
-                and participation > sum(cur.sync_aggregate.sync_committee_bits)
-            )
-        ):
-            return
-        from ..state_transition.upgrade import state_types
-        from ..ssz import uint64 as u64t
-
-        st = state_types(self.p, attested_state).BeaconState
-        _, state_branch = st.get_field_proof(attested_state, "finalized_checkpoint")
-        finality_branch = [u64t.hash_tree_root(fin_cp.epoch)] + [
-            bytes(b) for b in state_branch
-        ]
-        fu = Fields(
-            attested_header=block_to_header(self.p, attested_block.message),
-            finalized_header=block_to_header(self.p, fin_block.message),
-            finality_branch=finality_branch,
-            sync_aggregate=agg,
-            signature_slot=block.slot,
+        cur_slot = cur.attested_header.slot if cur is not None else -1
+        cur_part = (
+            sum(cur.sync_aggregate.sync_committee_bits) if cur is not None else -1
         )
-        self.latest_finality_update = fu
-        self.chain.emitter.emit(ChainEvent.LIGHT_CLIENT_FINALITY_UPDATE, fu)
+        if self._pending_finality is not None:
+            pend_block, _, pend_agg, _sig = self._pending_finality
+            cur_slot = max(cur_slot, pend_block.message.slot)
+            if pend_block.message.slot == attested_slot:
+                cur_part = max(cur_part, sum(pend_agg.sync_committee_bits))
+        if not (attested_slot > cur_slot
+                or (attested_slot == cur_slot and participation > cur_part)):
+            return
+        self._pending_finality = (attested_block, attested_state, agg, block.slot)
+        # only materialise eagerly when someone is listening for the event,
+        # and only emit a FRESHLY built update — a failed materialisation
+        # (finalized block missing from the store) must not re-emit stale
+        # state every import
+        if self.chain.emitter.has_listeners(ChainEvent.LIGHT_CLIENT_FINALITY_UPDATE):
+            fu = self._materialize_pending()
+            if fu is not None:
+                self.chain.emitter.emit(ChainEvent.LIGHT_CLIENT_FINALITY_UPDATE, fu)
 
     def _build_update(self, attested_block, attested_state, sync_aggregate,
                       signature_slot: int = 0):
@@ -183,21 +214,9 @@ class LightClientServer:
             _, nsc_branch = st.get_field_proof(attested_state, "next_sync_committee")
         except StopIteration:
             return None  # pre-altair attested state: no update possible
-        fin_cp = attested_state.finalized_checkpoint
-        finalized_header = None
-        if bytes(fin_cp.root) != b"\x00" * 32:
-            fin_block = self.chain.get_block_by_root(bytes(fin_cp.root))
-            if fin_block is not None:
-                finalized_header = block_to_header(self.p, fin_block.message)
-        # finality branch: checkpoint root within Checkpoint (epoch sibling)
-        # then finalized_checkpoint within the state
-        _, state_branch = st.get_field_proof(attested_state, "finalized_checkpoint")
-        t0 = self.t.phase0
-        epoch_leaf = t0.Epoch.hash_tree_root(fin_cp.epoch) if hasattr(t0, "Epoch") else None
-        from ..ssz import uint64 as u64t
-
-        epoch_leaf = u64t.hash_tree_root(fin_cp.epoch)
-        finality_branch = [epoch_leaf] + [bytes(b) for b in state_branch]
+        finalized_header, finality_branch = self._finality_proof(attested_state)
+        if finality_branch is None:
+            finality_branch = []
         empty_header = Fields(
             slot=0, proposer_index=0, parent_root=b"\x00" * 32,
             state_root=b"\x00" * 32, body_root=b"\x00" * 32,
@@ -221,7 +240,28 @@ class LightClientServer:
             return None
         return self.best_update_by_period[max(self.best_update_by_period)]
 
+    def _materialize_pending(self):
+        """Build the parked finality update; returns it only when freshly
+        built (None on no pending candidate or a missing finalized block)."""
+        if self._pending_finality is None:
+            return None
+        attested_block, attested_state, agg, sig_slot = self._pending_finality
+        self._pending_finality = None
+        finalized_header, finality_branch = self._finality_proof(attested_state)
+        if finalized_header is None:
+            return None
+        fu = Fields(
+            attested_header=block_to_header(self.p, attested_block.message),
+            finalized_header=finalized_header,
+            finality_branch=finality_branch,
+            sync_aggregate=agg,
+            signature_slot=sig_slot,
+        )
+        self.latest_finality_update = fu
+        return fu
+
     def get_finality_update(self):
+        self._materialize_pending()
         return self.latest_finality_update
 
     def get_optimistic_update(self):

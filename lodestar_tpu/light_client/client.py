@@ -221,9 +221,24 @@ class LightClient:
         old_fin = _has_finality(old)
         if new_fin != old_fin:
             return new_fin
+        # sync-committee finality: a finalized header in the attested
+        # header's own period keeps the committee rotation sound — without
+        # this, force_update can adopt a candidate whose finalized header
+        # crosses periods and strand the store (spec is_better_update)
+        if new_fin:
+            new_scf = self._sync_period(new.finalized_header.slot) == self._sync_period(
+                new.attested_header.slot
+            )
+            old_scf = self._sync_period(old.finalized_header.slot) == self._sync_period(
+                old.attested_header.slot
+            )
+            if new_scf != old_scf:
+                return new_scf
         if new_n != old_n:
             return new_n > old_n
-        return new.attested_header.slot < old.attested_header.slot
+        if new.attested_header.slot != old.attested_header.slot:
+            return new.attested_header.slot < old.attested_header.slot
+        return self._signature_slot(new) < self._signature_slot(old)
 
     # -- update processing (spec process_light_client_update) ------------------
 
@@ -302,7 +317,14 @@ class LightClient:
             # force update substitutes attested_header)
             update = Fields(**{k: u[k] for k in u.keys()})
             update.finalized_header = u.attested_header
-        self._apply(update)
+        try:
+            self._apply(update)
+        except LightClientError:
+            # a candidate the store cannot apply (e.g. cross-period
+            # finality with no committee) must not wedge the store: drop
+            # it so a better one can take the slot
+            self.best_valid_update = None
+            return False
         self.best_valid_update = None
         logger.info(
             "light client FORCED advance to slot %d (period %d)",

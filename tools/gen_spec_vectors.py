@@ -31,7 +31,7 @@ import yaml  # noqa: E402
 
 from lodestar_tpu.chain.bls_pool import BlsBatchPool  # noqa: E402
 from lodestar_tpu.config.chain_config import ChainConfig  # noqa: E402
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier  # noqa: E402
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier  # noqa: E402
 from lodestar_tpu.node.dev_chain import DevChain, clone_state  # noqa: E402
 from lodestar_tpu.params import MINIMAL  # noqa: E402
 from lodestar_tpu.ssz import Fields  # noqa: E402
@@ -58,8 +58,14 @@ CFG_ALTAIR = ChainConfig(
 )
 
 
-def case_dir(fork: str, runner: str, handler: str, suite: str, name: str) -> str:
-    d = os.path.join(ROOT, fork, runner, handler, suite, name)
+def case_dir(
+    fork: str, runner: str, handler: str, suite: str, name: str,
+    config: str = "minimal",
+) -> str:
+    base = ROOT if config == "minimal" else os.path.join(
+        os.path.dirname(ROOT), config
+    )
+    d = os.path.join(base, fork, runner, handler, suite, name)
     os.makedirs(d, exist_ok=True)
     return d
 
@@ -83,7 +89,7 @@ def block_bytes(fork: str, signed) -> bytes:
 
 
 async def build_chain(cfg, slots: int) -> DevChain:
-    pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.001)
+    pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.001)
     dev = DevChain(MINIMAL, cfg, 16, pool)
     await dev.run(slots)
     return dev
@@ -215,6 +221,16 @@ def gen_operations(dev: DevChain) -> None:
             write_ssz(d, "pre", state_bytes("phase0", pre))
             write_ssz(d, "attestation", T.phase0.Attestation.serialize(att))
             write_ssz(d, "post", state_bytes("phase0", post))
+            # invalid: inclusion-delay violation (attestation from this
+            # very slot); no post file => the runner must see a failure
+            bad = T.phase0.Attestation.deserialize(T.phase0.Attestation.serialize(att))
+            bad.data.slot = pre.slot
+            d = case_dir(
+                "phase0", "operations", "attestation", "pyspec_tests",
+                "invalid_future_slot",
+            )
+            write_ssz(d, "pre", state_bytes("phase0", pre))
+            write_ssz(d, "attestation", T.phase0.Attestation.serialize(bad))
             break
 
     # operations/block_header
@@ -609,8 +625,9 @@ async def gen_fork_choice_on_attestation() -> None:
 
 
 async def main() -> None:
-    if os.path.isdir(ROOT):
-        shutil.rmtree(ROOT)
+    top = os.path.dirname(ROOT)  # spec-tests/tests (all configs)
+    if os.path.isdir(top):
+        shutil.rmtree(top)
     dev = await build_chain(CFG, 4 * MINIMAL.SLOTS_PER_EPOCH + 2)
     assert dev.chain.fork_choice.store.finalized_checkpoint.epoch >= 1
     gen_sanity_and_finality(dev)
@@ -625,8 +642,13 @@ async def main() -> None:
     dev_altair = await build_chain(CFG_ALTAIR, MINIMAL.EPOCHS_PER_SYNC_COMMITTEE_PERIOD * MINIMAL.SLOTS_PER_EPOCH - 1)
     gen_transition(dev_altair)
     gen_epoch_processing_altair(dev_altair)
-    n = sum(len(files) for _, _, files in os.walk(ROOT))
-    print(f"wrote {n} files under {os.path.abspath(ROOT)}")
+    # breadth: altair/bellatrix categories, operation coverage, ssz depth,
+    # mainnet tree (tools/gen_spec_vectors2.py)
+    import gen_spec_vectors2
+
+    await gen_spec_vectors2.generate(dev, dev_altair)
+    n = sum(len(files) for _, _, files in os.walk(top))
+    print(f"wrote {n} files under {os.path.abspath(top)}")
 
 
 if __name__ == "__main__":
