@@ -113,9 +113,13 @@ class RestApiServer:
                 body = b""
                 if "content-length" in headers:
                     body = await reader.readexactly(int(headers["content-length"]))
+                import time as _time
+
+                _t0 = _time.monotonic()
                 status, payload, ctype = await self._dispatch(method, target, body)
                 if self.metrics:
                     self.metrics.api_requests_total.labels(status=str(status)).inc()
+                    self.metrics.api_response_seconds.observe(_time.monotonic() - _t0)
                 if ctype == "text/event-stream":
                     # SSE (routes/events.ts): stream chain events until the
                     # client goes away; the payload is an async generator

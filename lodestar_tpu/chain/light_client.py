@@ -97,18 +97,26 @@ class LightClientServer:
         self._track_head_updates(block, attested_block, attested_state, agg)
         period = sync_period_at_slot(self.p, attested_block.message.slot)
         # spec is_better_update cascade, computed without building the
-        # update: supermajority first, then participation below it, then
+        # update: supermajority, then participation below it, then
         # relevance ("relevant" = signed within the attested header's own
         # period, so a store whose next committee is still unknown can
         # verify it — an update attesting the LAST slot of a period is
-        # signed by the NEXT period's committee), then participation, then
-        # the fresher attested header (newer finality info)
+        # signed by the NEXT period's committee), then finality presence,
+        # then participation.  Final tie-break deviates from the spec's
+        # older-attested preference (a client-side stability heuristic):
+        # a SERVER serves the update ladder, and the fresher attested
+        # header carries the freshest finalized header — an early-period
+        # update's finality can predate a client's bootstrap entirely
         new_rel = sync_period_at_slot(self.p, block.slot) == period
+        new_fin = bytes(attested_state.finalized_checkpoint.root) != b"\x00" * 32
         cur = self.best_update_by_period.get(period)
         if cur is not None:
             max_bits = len(agg.sync_committee_bits)
             cur_part = sum(cur.sync_aggregate.sync_committee_bits)
             cur_rel = sync_period_at_slot(self.p, cur.signature_slot) == period
+            cur_fin = cur.finalized_header.slot != 0 or (
+                bytes(cur.finalized_header.state_root) != b"\x00" * 32
+            )
             new_sup = participation * 3 >= max_bits * 2
             cur_sup = cur_part * 3 >= max_bits * 2
             if new_sup != cur_sup:
@@ -117,6 +125,8 @@ class LightClientServer:
                 better = participation > cur_part
             elif new_rel != cur_rel:
                 better = new_rel
+            elif new_fin != cur_fin:
+                better = new_fin
             elif participation != cur_part:
                 better = participation > cur_part
             else:
@@ -182,11 +192,13 @@ class LightClientServer:
 
         if bytes(attested_state.finalized_checkpoint.root) == b"\x00" * 32:
             return
+        # participation only competes between SAME-slot candidates — an
+        # older update's high participation must not block a newer header
         cur = self.latest_finality_update
         cur_slot = cur.attested_header.slot if cur is not None else -1
-        cur_part = (
-            sum(cur.sync_aggregate.sync_committee_bits) if cur is not None else -1
-        )
+        cur_part = -1
+        if cur is not None and cur_slot == attested_slot:
+            cur_part = sum(cur.sync_aggregate.sync_committee_bits)
         if self._pending_finality is not None:
             pend_block, _, pend_agg, _sig = self._pending_finality
             cur_slot = max(cur_slot, pend_block.message.slot)

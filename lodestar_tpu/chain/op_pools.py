@@ -81,6 +81,15 @@ class AttestationPool:
             signature=aggregate_signatures(sigs).to_bytes(),
         )
 
+    def __len__(self) -> int:
+        # entries, not data-root groups — the pool-pressure number the
+        # MAX_PER_SLOT bound also counts
+        return sum(
+            len(g.bits_and_sigs)
+            for groups in self._by_slot.values()
+            for g in groups.values()
+        )
+
     def prune(self, clock_slot: int) -> None:
         for slot in list(self._by_slot):
             if slot < clock_slot - self.SLOTS_RETAINED:
@@ -132,6 +141,13 @@ class AggregatedAttestationPool:
                     out.append((score, att))
         out.sort(key=lambda x: -x[0])
         return [att for _, att in out[: self.p.MAX_ATTESTATIONS]]
+
+    def __len__(self) -> int:
+        return sum(
+            len(aggs)
+            for groups in self._by_slot.values()
+            for aggs in groups.values()
+        )
 
     def prune(self, clock_slot: int) -> None:
         for slot in list(self._by_slot):

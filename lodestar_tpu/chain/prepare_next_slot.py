@@ -34,11 +34,21 @@ class PrepareNextSlotScheduler:
         self._prepared: Optional[Tuple[bytes, int, object, object]] = None
 
     async def prepare(self, next_slot: int) -> None:
+        import time
+
         head_root = self.chain.head_root
         state = clone_state(self.p, self.chain.head_state())
         if state.slot >= next_slot:
             return
+        crosses_epoch = next_slot % self.p.SLOTS_PER_EPOCH == 0
+        t0 = time.monotonic()
         ctx = process_slots(self.p, self.chain.cfg, state, next_slot)
+        if crosses_epoch and self.chain.metrics:
+            # the precomputed epoch transition — the cost the 2/3-slot tick
+            # absorbs off the import path (lodestar.ts stfnEpochTransition)
+            self.chain.metrics.epoch_transition_seconds.observe(
+                time.monotonic() - t0
+            )
         self._prepared = (head_root, next_slot, state, ctx)
         logger.debug("prepared state for slot %d on head %s", next_slot, head_root.hex()[:8])
 

@@ -87,9 +87,16 @@ class StateRegenerator:
 
     def get_state_by_block_root(self, block_root: bytes, max_replay: int = 32):
         """State after applying the block at `block_root` (getState)."""
+        import time
+
         cached = self.cache.get(block_root)
         if cached is not None:
+            if self.metrics:
+                self.metrics.state_cache_hits_total.inc()
             return cached
+        if self.metrics:
+            self.metrics.state_cache_misses_total.inc()
+        t0 = time.monotonic()
         # walk back to a cached ancestor, replaying forward
         chain: List[object] = []
         root = block_root
@@ -116,6 +123,8 @@ class StateRegenerator:
             )
             broot = self.t.BeaconBlock.hash_tree_root(block.message)
             self.cache.add(broot, state)
+        if self.metrics:
+            self.metrics.regen_seconds.observe(time.monotonic() - t0)
         return state
 
     def get_pre_state(self, block) -> object:

@@ -39,6 +39,9 @@ class SyncCommitteeMessagePool:
         # (slot, root, subcommittee) -> {index_in_subcommittee: signature}
         self._msgs: Dict[Tuple[int, bytes, int], Dict[int, bytes]] = {}
 
+    def __len__(self) -> int:
+        return sum(len(v) for v in self._msgs.values())
+
     def add(self, slot: int, block_root: bytes, subcommittee: int,
             index_in_subcommittee: int, signature: bytes) -> None:
         key = (slot, bytes(block_root), subcommittee)

@@ -305,21 +305,25 @@ async def run_beacon(args) -> int:
             pubkey=_hex_bytes(args.builder_pubkey, 48, "--builder-pubkey")
             if args.builder_pubkey else None,
         )
+    from .metrics import create_metrics
+
+    metrics = create_metrics()
     chain = BeaconChain(
-        preset, cfg, genesis, pool, db=db,
+        preset, cfg, genesis, pool, db=db, metrics=metrics,
         execution_engine=execution_engine, builder=builder,
         default_fee_recipient=_hex_bytes(
             args.suggested_fee_recipient, 20, "--suggested-fee-recipient"
         ),
     )
     handlers = GossipHandlers(chain)
-    network = Network(preset, chain, handlers)
+    network = Network(preset, chain, handlers, metrics=metrics)
     await network.listen(args.listen_port)
     for target in args.connect:
         host, _, port = target.partition(":")
         peer = await network.connect(host, int(port))
         logger.info("connected to %s (head slot %s)", target, peer.status.head_slot)
-    rest = RestApiServer(preset, chain, network=network)
+    rest = RestApiServer(preset, chain, network=network,
+                         metrics_registry=metrics.reg, metrics=metrics)
     rest.gossip_handlers = handlers
     await rest.listen(args.rest_port)
     if args.discovery_port is not None:
@@ -341,10 +345,14 @@ async def run_beacon(args) -> int:
         from .sync.backfill import BackfillSync
 
         backfill = BackfillSync(
-            preset, cfg, db, pool, genesis, anchor_block_root, network.peer_manager
+            preset, cfg, db, pool, genesis, anchor_block_root,
+            network.peer_manager, metrics=metrics,
         )
         backfill_task = asyncio.create_task(backfill.run())
-    sync = RangeSync(preset, chain, network.peer_manager, report_peer=network.report_peer)
+    sync = RangeSync(
+        preset, chain, network.peer_manager, metrics=metrics,
+        report_peer=network.report_peer,
+    )
     imported = await sync.run_to_head()
     if backfill_task is not None:
         stored = await backfill_task

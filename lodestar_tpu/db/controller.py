@@ -78,6 +78,52 @@ class MemoryDbController:
         pass
 
 
+class MeteredDbController:
+    """IDatabaseController decorator timing every operation into the
+    metrics registry (lodestar.ts dbReadReq/dbWriteReq/dbReadItems
+    analog) — wraps any backend without touching it."""
+
+    def __init__(self, inner: IDatabaseController, metrics):
+        self._inner = inner
+        self._m = metrics
+
+    def _timed(self, op: str, fn, *a):
+        import time
+
+        t0 = time.monotonic()
+        try:
+            return fn(*a)
+        finally:
+            self._m.db_ops_total.labels(op=op).inc()
+            self._m.db_op_seconds.labels(op=op).observe(time.monotonic() - t0)
+
+    def get(self, key):
+        return self._timed("get", self._inner.get, key)
+
+    def put(self, key, value):
+        return self._timed("put", self._inner.put, key, value)
+
+    def delete(self, key):
+        return self._timed("delete", self._inner.delete, key)
+
+    def batch_put(self, items):
+        return self._timed("batch_put", self._inner.batch_put, items)
+
+    def batch_delete(self, keys):
+        return self._timed("batch_delete", self._inner.batch_delete, keys)
+
+    def entries(self, gte=None, lt=None, reverse=False, limit=None):
+        # materialize inside the timing window: generator pulls otherwise
+        # escape the measurement entirely
+        rows = self._timed(
+            "entries", lambda: list(self._inner.entries(gte, lt, reverse, limit))
+        )
+        return iter(rows)
+
+    def close(self) -> None:
+        self._inner.close()
+
+
 class SqliteDbController:
     """sqlite3-backed persistent backend.
 

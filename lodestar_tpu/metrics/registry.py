@@ -151,6 +151,114 @@ class Metrics:
         self.api_requests_total = r.counter(
             "lodestar_api_requests_total", "REST API requests", labels=("status",)
         )
+        self.api_response_seconds = r.histogram(
+            "lodestar_api_response_seconds",
+            "REST API handler latency",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5),
+        )
+        # db controller (db/controller metrics — lodestar.ts dbReadReq/dbWriteReq)
+        self.db_op_seconds = r.histogram(
+            "lodestar_db_op_seconds",
+            "db controller operation latency",
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5),
+            labels=("op",),
+        )
+        self.db_ops_total = r.counter(
+            "lodestar_db_ops_total", "db controller operations", labels=("op",)
+        )
+        # reqresp (lodestar.ts reqResp* family)
+        self.reqresp_request_seconds = r.histogram(
+            "lodestar_reqresp_request_seconds",
+            "outbound req/resp round-trip latency",
+            buckets=(0.01, 0.05, 0.1, 0.5, 1, 5, 10),
+            labels=("method",),
+        )
+        self.reqresp_errors_total = r.counter(
+            "lodestar_reqresp_errors_total",
+            "req/resp failures",
+            labels=("method", "reason"),
+        )
+        # gossipsub mesh + scoring (lodestar.ts gossipPeer.score*, mesh*)
+        self.gossip_mesh_peers = r.gauge(
+            "lodestar_gossip_mesh_peers", "mesh degree per topic", labels=("topic",)
+        )
+        self.gossip_peer_score = r.histogram(
+            "lodestar_gossip_peer_score",
+            "gossip peer score distribution at heartbeat",
+            buckets=(-100, -10, -1, 0, 1, 10, 100),
+        )
+        self.gossip_control_total = r.counter(
+            "lodestar_gossip_control_total",
+            "gossipsub control records",
+            labels=("kind", "dir"),
+        )
+        self.gossip_validation_total = r.counter(
+            "lodestar_gossip_validation_total",
+            "gossip validation verdicts",
+            labels=("topic", "verdict"),
+        )
+        # state transition (lodestar.ts stfn* family)
+        self.epoch_transition_seconds = r.histogram(
+            "lodestar_epoch_transition_seconds",
+            "epoch transition wall time",
+            buckets=(0.01, 0.05, 0.1, 0.5, 1, 5, 30),
+        )
+        self.state_transition_seconds = r.histogram(
+            "lodestar_state_transition_seconds",
+            "per-block state transition wall time",
+            buckets=(0.001, 0.01, 0.05, 0.1, 0.5, 1, 5),
+        )
+        self.prepare_next_slot_hits_total = r.counter(
+            "lodestar_prepare_next_slot_hits_total",
+            "block imports/productions served by the precomputed next-slot state",
+        )
+        # op pools (lodestar.ts opPool* family)
+        self.op_pool_size = r.gauge(
+            "lodestar_op_pool_size", "operations pooled", labels=("pool",)
+        )
+        # seen caches
+        self.seen_cache_hits_total = r.counter(
+            "lodestar_seen_cache_hits_total", "seen-cache hits", labels=("cache",)
+        )
+        # state cache effectiveness (stateCache.hits/misses)
+        self.state_cache_hits_total = r.counter(
+            "lodestar_state_cache_hits_total", "state cache hits"
+        )
+        self.state_cache_misses_total = r.counter(
+            "lodestar_state_cache_misses_total",
+            "state cache misses (regen replay needed)",
+        )
+        self.regen_seconds = r.histogram(
+            "lodestar_regen_seconds",
+            "state regeneration latency (checkpoint load + replay)",
+            buckets=(0.001, 0.01, 0.05, 0.1, 0.5, 1, 5),
+        )
+        # sync extras
+        self.sync_batch_seconds = r.histogram(
+            "lodestar_range_sync_batch_seconds",
+            "range sync per-batch import wall time (download excluded)",
+            buckets=(0.1, 0.5, 1, 5, 10, 30),
+        )
+        self.backfill_blocks_total = r.counter(
+            "lodestar_backfill_blocks_total", "blocks imported via backfill sync"
+        )
+        # validator monitor depth (validatorMonitor.ts:165)
+        self.monitor_inclusion_delay = r.histogram(
+            "lodestar_validator_monitor_inclusion_delay_slots",
+            "attestation inclusion delay of registered validators",
+            buckets=(1, 2, 3, 4, 8, 16, 32),
+        )
+        self.monitor_sync_committee_hit_ratio = r.gauge(
+            "lodestar_validator_monitor_sync_committee_hit_ratio",
+            "fraction of registered sync-committee duties fulfilled per epoch",
+        )
+        self.monitor_timely_total = r.counter(
+            "lodestar_validator_monitor_timely_total",
+            "registered validators' attestation timeliness flags",
+            labels=("flag",),
+        )
+        # clock
+        self.clock_slot = r.gauge("lodestar_clock_slot", "current wall-clock slot")
 
 
 def create_metrics() -> Metrics:

@@ -192,7 +192,9 @@ class GossipRouter:
         on_reject: Optional[Callable[[str, str], None]] = None,
         on_evict: Optional[Callable[[str, float], None]] = None,
         heartbeat_interval: float = HEARTBEAT_INTERVAL,
+        metrics=None,
     ):
+        self.metrics = metrics
         self.subscriptions: Dict[str, Callable[[bytes], Awaitable[None]]] = {}
         self.seen = SeenMessages()
         self.peers: Dict[str, _PeerState] = {}
@@ -301,6 +303,11 @@ class GossipRouter:
             await handler(ssz_bytes)
         except GossipValidationError as e:
             logger.debug("gossip %s: %s", topic, e)
+            if self.metrics:
+                verdict = "reject" if e.action == GossipAction.REJECT else "ignore"
+                self.metrics.gossip_validation_total.labels(
+                    topic=parse_topic(topic) or topic, verdict=verdict
+                ).inc()
             if e.action == GossipAction.REJECT and from_peer:
                 if from_peer in self.peers:
                     self.peers[from_peer].topic_counters(topic).invalid_message_deliveries += 1
@@ -314,6 +321,10 @@ class GossipRouter:
             # whole peer set; only REJECT downscores
             logger.warning("gossip handler error on %s: %s", topic, e)
             return
+        if self.metrics:
+            self.metrics.gossip_validation_total.labels(
+                topic=parse_topic(topic) or topic, verdict="accept"
+            ).inc()
         if forward:
             for key in self._publish_targets(topic):
                 if key == from_peer:
@@ -447,6 +458,21 @@ class GossipRouter:
                     await self.peers[key].send_ctrl({"prune": topics})
                 except Exception:
                     pass
+        if self.metrics:
+            for topic, members in self.mesh.items():
+                self.metrics.gossip_mesh_peers.labels(
+                    topic=parse_topic(topic) or topic
+                ).set(len(members))
+            for st in self.peers.values():
+                self.metrics.gossip_peer_score.observe(st.score())
+            for key, topics in grafts.items():
+                self.metrics.gossip_control_total.labels(kind="graft", dir="out").inc(
+                    len(topics)
+                )
+            for key, topics in prunes.items():
+                self.metrics.gossip_control_total.labels(kind="prune", dir="out").inc(
+                    len(topics)
+                )
         await self._emit_gossip()
         self._decay_scores()
         self._iwant_budget.clear()

@@ -59,7 +59,8 @@ class Network:
         self.peer_manager = PeerManager()
         self.score_store = PeerRpcScoreStore()
         self.router = GossipRouter(
-            on_reject=self._on_gossip_reject, on_evict=self._on_gossip_evict
+            on_reject=self._on_gossip_reject, on_evict=self._on_gossip_evict,
+            metrics=metrics,
         )
         # subnet services + seq-numbered metadata (SURVEY §2.5 attnets/
         # syncnets; served to peers over reqresp METADATA)
@@ -166,7 +167,7 @@ class Network:
             writer.close()
             raise ConnectionRefusedError(f"peer {remote_key} is banned")
         wire = Wire(reader, writer)
-        reqresp = ReqRespNode(self.p, self.chain, wire, metadata=self.metadata)
+        reqresp = ReqRespNode(self.p, self.chain, wire, metadata=self.metadata, metrics=self.metrics)
         peer = Peer(peer_id=peer_id, reqresp=reqresp, wire=wire, remote_key=remote_key)
 
         async def gossip_send(topic: str, ssz_bytes: bytes) -> None:

@@ -97,9 +97,10 @@ class ReqRespNode:
     blocks from the hot db + archive (handlers/beaconBlocksByRange.ts).
     """
 
-    def __init__(self, preset: Preset, chain, wire: Wire, metadata=None):
+    def __init__(self, preset: Preset, chain, wire: Wire, metadata=None, metrics=None):
         self.p = preset
         self.chain = chain
+        self.metrics = metrics
         self.t = get_types(preset).phase0
         self.wire = wire
         self.metadata_controller = metadata  # network/metadata.ts source
@@ -113,6 +114,25 @@ class ReqRespNode:
     # -- client side -----------------------------------------------------------
 
     async def _request(self, method: int, ssz_bytes: bytes, timeout: float = 10.0) -> List[bytes]:
+        import time as _time
+
+        _t0 = _time.monotonic()
+        try:
+            return await self._request_inner(method, ssz_bytes, timeout)
+        except (RequestError, asyncio.TimeoutError) as e:
+            if self.metrics:
+                reason = "timeout" if isinstance(e, asyncio.TimeoutError) else "error"
+                self.metrics.reqresp_errors_total.labels(
+                    method=str(method), reason=reason
+                ).inc()
+            raise
+        finally:
+            if self.metrics:
+                self.metrics.reqresp_request_seconds.labels(method=str(method)).observe(
+                    _time.monotonic() - _t0
+                )
+
+    async def _request_inner(self, method: int, ssz_bytes: bytes, timeout: float = 10.0) -> List[bytes]:
         req_id = next(self._req_ids)
         q: asyncio.Queue = asyncio.Queue()
         self._pending[req_id] = q

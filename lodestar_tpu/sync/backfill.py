@@ -38,9 +38,10 @@ class BackfillSync:
 
     def __init__(
         self, preset: Preset, cfg: ChainConfig, db, bls_pool, anchor_state,
-        anchor_block_root: bytes, peer_manager,
+        anchor_block_root: bytes, peer_manager, metrics=None,
     ):
         self.p = preset
+        self.metrics = metrics
         self.cfg = cfg
         self.db = db
         self.bls = bls_pool
@@ -131,6 +132,8 @@ class BackfillSync:
         self.db.backfilled_ranges.put(
             b"backfill", {"oldest_slot": int(first.slot)}
         )
+        if self.metrics:
+            self.metrics.backfill_blocks_total.inc(len(blocks))
         return len(blocks)
 
     # -- driver ----------------------------------------------------------------

@@ -152,12 +152,18 @@ class RangeSync:
                         empty_servers.append(peer)
                         break
                     try:
+                        import time as _time
+
+                        _bt0 = _time.monotonic()
                         n_ok = await self.chain.process_chain_segment(blocks)
                         imported += n_ok
                         progressed = progressed or n_ok > 0
                         if self.metrics:
                             self.metrics.sync_batches_total.inc()
                             self.metrics.sync_blocks_total.inc(n_ok)
+                            self.metrics.sync_batch_seconds.observe(
+                                _time.monotonic() - _bt0
+                            )
                         break
                     except Exception as e:  # noqa: BLE001
                         # bad batch: downscore the server and retry the
