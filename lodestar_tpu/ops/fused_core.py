@@ -498,26 +498,27 @@ _CONSTS_RED_PAD = _MC_CONSTS
 _CONSTS_CANON = _MC_CONSTS + (_MU6, _P48, _PC, _P2C, _HOT0_51)
 
 
-def _pcall(kernel, args, consts, out_tail_shapes, interpret):
+def _pcall(kernel, args, consts, out_tail_shapes, interpret, blk: int = BLK):
     """Run ``kernel`` over row blocks.
 
     args: data arrays with identical leading row count N; consts: numpy
     constant arrays handed to every program whole (kernel constants must be
     operands, never closure captures — the round-4 rule).  Rows are
-    independent, so N is padded up to a BLK multiple and the grid iterates
-    row blocks — one Mosaic compile per kernel, any N.
+    independent, so N is padded up to a block multiple and the grid
+    iterates row blocks — one Mosaic compile per kernel, any N.  ``blk``
+    shrinks the block for operand-heavy kernels (VMEM budget).
     """
     n = args[0].shape[0]
-    npad = -(-n // BLK) * BLK
+    npad = -(-n // blk) * blk
     padded = [
         jnp.pad(a, [(0, npad - n)] + [(0, 0)] * (a.ndim - 1)) if npad != n else a
         for a in args
     ]
-    grid = (npad // BLK,)
+    grid = (npad // blk,)
 
     def spec(tail):
         nd = len(tail)
-        return pl.BlockSpec((BLK,) + tail, lambda i, _nd=nd: (i,) + (0,) * _nd)
+        return pl.BlockSpec((blk,) + tail, lambda i, _nd=nd: (i,) + (0,) * _nd)
 
     def const_spec(shape):
         nd = len(shape)

@@ -137,7 +137,9 @@ def miller_product_fused(
         ],
         axis=0,
     )  # (4, N, 128)
-    out = point_mul_bits(stacked, bits, ns2, complete=True, interpret=interpret)
+    from .fused_ladder import point_mul_bits_ladder
+
+    out = point_mul_bits_ladder(stacked, bits, ns2, interpret=interpret)
     z_sig = tuple(LV(c.a[0], c.b) for c in out)
     t1 = tuple(LV(c.a[1], c.b) for c in out)
     t2 = tuple(LV(c.a[2], c.b) for c in out)

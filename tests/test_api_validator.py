@@ -15,7 +15,7 @@ from lodestar_tpu.chain.bls_pool import BlsBatchPool
 from lodestar_tpu.chain.handlers import GossipHandlers
 from lodestar_tpu.config.chain_config import ChainConfig
 from lodestar_tpu.crypto.bls.api import interop_secret_key
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
 from lodestar_tpu.metrics.registry import MetricsRegistry
 from lodestar_tpu.node.dev_chain import DevChain
 from lodestar_tpu.params import MINIMAL
@@ -37,7 +37,7 @@ N = 16
 
 def test_vc_drives_chain_over_http():
     async def main():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         dev = DevChain(MINIMAL, CFG, N, pool)
         metrics = MetricsRegistry()
         server = RestApiServer(MINIMAL, dev.chain, metrics_registry=None)
@@ -160,7 +160,7 @@ def test_vc_store_refuses_double_vote_via_signing_path():
 
 def test_doppelganger_detection_via_liveness():
     async def main():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         dev = DevChain(MINIMAL, CFG, N, pool)
         # run an epoch with attestations so the block-attester cache fills
         await dev.run(MINIMAL.SLOTS_PER_EPOCH + 2)
@@ -195,7 +195,7 @@ def test_config_and_node_namespaces():
     """config/spec + fork_schedule + deposit_contract and node/peers
     routes (routes/config.ts, routes/node.ts)."""
     async def main():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         dev = DevChain(MINIMAL, CFG, N, pool)
         server = RestApiServer(MINIMAL, dev.chain)
         port = await server.listen(0)

@@ -13,7 +13,7 @@ from lodestar_tpu.chain.beacon_chain import BeaconChain, BlockError
 from lodestar_tpu.chain.beacon_proposer_cache import BeaconProposerCache
 from lodestar_tpu.chain.bls_pool import BlsBatchPool
 from lodestar_tpu.config.chain_config import ChainConfig
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
 from lodestar_tpu.execution.builder import (
     ExecutionBuilderMock,
     blind_body,
@@ -41,7 +41,7 @@ def _cfg() -> ChainConfig:
 def _dev_with_builder():
     engine = ExecutionEngineMock(MINIMAL, genesis_block_hash=b"\x11" * 32)
     cfg = _cfg()
-    pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.001)
+    pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.001)
     dev = DevChain(MINIMAL, cfg, 16, pool, execution_engine=engine)
     builder = ExecutionBuilderMock(
         MINIMAL, engine, fork_version=cfg.GENESIS_FORK_VERSION
@@ -194,7 +194,7 @@ def test_blinded_proposal_e2e():
 
 def test_produce_blinded_without_builder_raises():
     engine = ExecutionEngineMock(MINIMAL, genesis_block_hash=b"\x11" * 32)
-    pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.001)
+    pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.001)
     dev = DevChain(MINIMAL, _cfg(), 16, pool, execution_engine=engine)
     with pytest.raises(BlockError, match="no builder"):
         asyncio.run(dev.chain.produce_blinded_block(1, b"\x00" * 96))

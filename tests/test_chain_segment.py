@@ -16,7 +16,7 @@ from lodestar_tpu.chain.bls_pool import BlsBatchPool
 from lodestar_tpu.chain.beacon_chain import BlockError
 from lodestar_tpu.config.chain_config import ChainConfig
 from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+
 from lodestar_tpu.node.dev_chain import DevChain
 from lodestar_tpu.params import MINIMAL
 
@@ -29,7 +29,7 @@ CFG = ChainConfig(
 
 def _pool():
     v = FastBlsVerifier()
-    return BlsBatchPool(v if v.native else PyBlsVerifier(), max_buffer_wait=0.005)
+    return BlsBatchPool(v if v.native else FastBlsVerifier(), max_buffer_wait=0.005)
 
 
 def _build_segment(n_slots: int):

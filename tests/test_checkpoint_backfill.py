@@ -14,7 +14,7 @@ from lodestar_tpu.chain.beacon_chain import BeaconChain
 from lodestar_tpu.chain.bls_pool import BlsBatchPool
 from lodestar_tpu.chain.handlers import GossipHandlers
 from lodestar_tpu.config.chain_config import ChainConfig
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
 from lodestar_tpu.network import Network
 from lodestar_tpu.node.checkpoint_sync import fetch_checkpoint_state
 from lodestar_tpu.node.dev_chain import DevChain
@@ -33,7 +33,7 @@ N = 16
 def test_checkpoint_sync_then_backfill_then_follow():
     async def main():
         # node A: run far enough that finalization advances past genesis
-        pool_a = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool_a = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         a = DevChain(MINIMAL, CFG, N, pool_a)
         await a.run(4 * MINIMAL.SLOTS_PER_EPOCH + 2)
         fin = a.chain.fork_choice.store.finalized_checkpoint
@@ -66,7 +66,7 @@ def test_checkpoint_sync_then_backfill_then_follow():
         assert anchor_root == fin.root
         assert state.slot > 0
 
-        pool_b = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool_b = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         chain_b = BeaconChain(MINIMAL, CFG, state, pool_b)
         chain_b.db.block.put(anchor_root, anchor_block)
         chain_b.db.archive_block(anchor_block, anchor_root)
@@ -110,7 +110,7 @@ def test_checkpoint_sync_then_backfill_then_follow():
 
 def test_backfill_rejects_tampered_history():
     async def main():
-        pool_a = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool_a = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         a = DevChain(MINIMAL, CFG, N, pool_a)
         await a.run(2 * MINIMAL.SLOTS_PER_EPOCH, with_attestations=False)
 
@@ -121,7 +121,7 @@ def test_backfill_rejects_tampered_history():
         head_root = a.chain.head_root
         head_block = a.chain.get_block_by_root(head_root)
         state = a.chain.head_state()
-        pool_b = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool_b = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         chain_b = BeaconChain(MINIMAL, CFG, state, pool_b)
         chain_b.db.block.put(head_root, head_block)
         chain_b.db.archive_block(head_block, head_root)

@@ -12,6 +12,7 @@ import pytest
 from lodestar_tpu.chain.bls_pool import BlsBatchPool
 from lodestar_tpu.config.chain_config import ChainConfig
 from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
 from lodestar_tpu.metrics import create_metrics
 from lodestar_tpu.node.dev_chain import DevChain
 from lodestar_tpu.params import MINIMAL
@@ -70,7 +71,7 @@ def test_dev_chain_advances_and_verifies_through_boundary():
 
 def test_dev_chain_two_epochs_justifies():
     async def main():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         dev = DevChain(MINIMAL, CFG, N_VALIDATORS, pool)
         finalized_events = []
         from lodestar_tpu.chain.emitter import ChainEvent
@@ -90,7 +91,7 @@ def test_dev_chain_two_epochs_justifies():
 
 def test_dev_chain_rejects_bad_block():
     async def main():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         dev = DevChain(MINIMAL, CFG, N_VALIDATORS, pool)
         await dev.run(1)
         # corrupt: re-import a block with a bad proposer signature

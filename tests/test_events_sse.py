@@ -11,7 +11,7 @@ from lodestar_tpu.api import RestApiServer
 from lodestar_tpu.api.client import ApiClient
 from lodestar_tpu.chain.bls_pool import BlsBatchPool
 from lodestar_tpu.config.chain_config import ChainConfig
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
 from lodestar_tpu.node.dev_chain import DevChain
 from lodestar_tpu.params import MINIMAL
 from lodestar_tpu.validator import ChainHeaderTracker
@@ -25,7 +25,7 @@ CFG = ChainConfig(
 
 def test_events_stream_delivers_head_block_finalized():
     async def main():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         dev = DevChain(MINIMAL, CFG, 16, pool)
         rest = RestApiServer(MINIMAL, dev.chain)
         port = await rest.listen(0)
@@ -59,7 +59,7 @@ def test_events_stream_delivers_head_block_finalized():
 
 def test_vc_attests_on_head_event_not_clock():
     async def main():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         dev = DevChain(MINIMAL, CFG, 16, pool)
         rest = RestApiServer(MINIMAL, dev.chain)
         port = await rest.listen(0)

@@ -11,7 +11,7 @@ from lodestar_tpu import flare
 from lodestar_tpu.api import RestApiServer
 from lodestar_tpu.chain.bls_pool import BlsBatchPool
 from lodestar_tpu.config.chain_config import ChainConfig
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
 from lodestar_tpu.node.dev_chain import DevChain
 from lodestar_tpu.params import MINIMAL
 
@@ -24,7 +24,7 @@ CFG = ChainConfig(
 
 def test_self_slash_proposer_flows_into_pool():
     async def main():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         dev = DevChain(MINIMAL, CFG, 16, pool)
         server = RestApiServer(MINIMAL, dev.chain)
         port = await server.listen(0)
@@ -50,7 +50,7 @@ def test_self_slash_proposer_flows_into_pool():
 
 def test_self_slash_attester_flows_into_pool():
     async def main():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         dev = DevChain(MINIMAL, CFG, 16, pool)
         server = RestApiServer(MINIMAL, dev.chain)
         port = await server.listen(0)

@@ -14,7 +14,7 @@ import pytest
 from lodestar_tpu.chain.bls_pool import BlsBatchPool
 from lodestar_tpu.config.chain_config import ChainConfig
 from lodestar_tpu.config.fork_config import ForkName
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
 from lodestar_tpu.node.dev_chain import DevChain
 from lodestar_tpu.params import MINIMAL
 from lodestar_tpu.state_transition.upgrade import state_fork_name
@@ -32,7 +32,7 @@ N_VALIDATORS = 32
 
 def test_dev_chain_crosses_altair_and_bellatrix_and_finalizes():
     async def main():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         dev = DevChain(MINIMAL, CFG, N_VALIDATORS, pool)
 
         # genesis era: phase0
@@ -68,7 +68,7 @@ def test_altair_upgrade_state_shape():
     """The upgraded state hashes/serializes under the altair schema."""
 
     async def main():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         dev = DevChain(MINIMAL, CFG, N_VALIDATORS, pool)
         await dev.run(MINIMAL.SLOTS_PER_EPOCH + 1)
         state = dev.chain.head_state()

@@ -24,7 +24,7 @@ from lodestar_tpu.chain.validation import (
     validate_gossip_voluntary_exit,
 )
 from lodestar_tpu.config.chain_config import ChainConfig
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
 from lodestar_tpu.node.dev_chain import DevChain
 from lodestar_tpu.params import MINIMAL, DOMAIN_BEACON_ATTESTER
 from lodestar_tpu.ssz import Fields
@@ -55,7 +55,7 @@ class Env:
 @pytest.fixture(scope="module")
 def env():
     async def build():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         dev = DevChain(MINIMAL, CFG, 32, pool)
         await dev.run(2, with_attestations=False)
         return Env(dev, pool)

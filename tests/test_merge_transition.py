@@ -15,7 +15,7 @@ import pytest
 from lodestar_tpu.chain.beacon_chain import BeaconChain, BlockError
 from lodestar_tpu.chain.bls_pool import BlsBatchPool
 from lodestar_tpu.config.chain_config import ChainConfig
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
 from lodestar_tpu.execution.engine import ExecutePayloadStatus, ExecutionEngineMock
 from lodestar_tpu.node.dev_chain import DevChain
 from lodestar_tpu.params import MINIMAL
@@ -35,7 +35,7 @@ def _cfg() -> ChainConfig:
 
 
 def _dev(engine) -> DevChain:
-    pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.001)
+    pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.001)
     return DevChain(MINIMAL, _cfg(), 16, pool, execution_engine=engine)
 
 

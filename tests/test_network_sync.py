@@ -12,7 +12,7 @@ import asyncio
 from lodestar_tpu.chain.bls_pool import BlsBatchPool
 from lodestar_tpu.chain.handlers import GossipHandlers
 from lodestar_tpu.config.chain_config import ChainConfig
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
 from lodestar_tpu.network import Network
 from lodestar_tpu.node.dev_chain import DevChain
 from lodestar_tpu.params import MINIMAL
@@ -29,8 +29,8 @@ N = 16
 
 def make_pair():
     """Two dev nodes sharing genesis (same interop keys/time)."""
-    pool_a = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
-    pool_b = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+    pool_a = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
+    pool_b = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
     a = DevChain(MINIMAL, CFG, N, pool_a)
     b = DevChain(MINIMAL, CFG, N, pool_b)
     return a, b, pool_a, pool_b
@@ -120,7 +120,7 @@ def test_range_sync_survives_garbage_peer():
 
     async def main():
         a, b, pool_a, pool_b = make_pair()
-        pool_c = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool_c = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         c = DevChain(MINIMAL, CFG, N, pool_c)  # the syncing node
         await a.run(MINIMAL.SLOTS_PER_EPOCH + 4, with_attestations=False)
         # b mirrors a's chain so it can serve the same canonical blocks

@@ -12,7 +12,7 @@ import asyncio
 from lodestar_tpu.chain.bls_pool import BlsBatchPool
 from lodestar_tpu.chain.handlers import GossipHandlers
 from lodestar_tpu.config.chain_config import ChainConfig
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
 from lodestar_tpu.db.beacon import BeaconDb
 from lodestar_tpu.db.controller import MemoryDbController
 from lodestar_tpu.node.dev_chain import DevChain
@@ -50,7 +50,7 @@ def make_exit(dev, validator_index: int):
 
 def test_wired_node_end_to_end():
     async def main():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         db = BeaconDb(MINIMAL, MemoryDbController())
         dev = DevChain(MINIMAL, CFG, N, pool, db=db)
         chain = dev.chain

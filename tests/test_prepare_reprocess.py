@@ -24,7 +24,7 @@ CFG = ChainConfig(
 
 def test_prepare_next_slot_caches_advanced_state():
     async def main():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         dev = DevChain(MINIMAL, CFG, 16, pool)
         await dev.run(2, with_attestations=False)
         sched = PrepareNextSlotScheduler(MINIMAL, dev.chain)
@@ -45,7 +45,7 @@ def test_prepare_next_slot_caches_advanced_state():
 
 def test_reprocess_resolves_on_block_import():
     async def main():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         dev = DevChain(MINIMAL, CFG, 16, pool)
         rc = ReprocessController(dev.chain)
 
@@ -106,7 +106,7 @@ def test_import_consumes_prepared_state_at_epoch_boundary():
 
     async def run():
         v = FastBlsVerifier()
-        pool = BlsBatchPool(v if v.native else PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(v if v.native else FastBlsVerifier(), max_buffer_wait=0.005)
         dev = DevChain(MINIMAL, cfg, 16, pool)
         # advance to one slot before the epoch boundary
         boundary = MINIMAL.SLOTS_PER_EPOCH  # first slot of epoch 1

@@ -7,7 +7,7 @@ import pytest
 from lodestar_tpu.chain.bls_pool import BlsBatchPool
 from lodestar_tpu.chain.regen import CheckpointStateCache, RegenError, StateContextCache, StateRegenerator
 from lodestar_tpu.config.chain_config import ChainConfig
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
 from lodestar_tpu.node.dev_chain import DevChain
 from lodestar_tpu.params import MINIMAL
 from lodestar_tpu.types import get_types
@@ -40,7 +40,7 @@ class TestLru:
 
 def test_regen_replays_from_cached_ancestor():
     async def main():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         dev = DevChain(MINIMAL, CFG, 16, pool)
         await dev.run(3, with_attestations=False)
         chain = dev.chain

@@ -17,7 +17,7 @@ from lodestar_tpu.chain.bls_pool import BlsBatchPool
 from lodestar_tpu.chain.handlers import GossipHandlers
 from lodestar_tpu.config.chain_config import ChainConfig
 from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+
 from lodestar_tpu.network import Network
 from lodestar_tpu.node.dev_chain import DevChain, clone_state
 from lodestar_tpu.params import DOMAIN_BEACON_ATTESTER, MINIMAL
@@ -42,7 +42,7 @@ SUBSETS = [range(0, 6), range(6, 11), range(11, 16)]
 
 def _verifier():
     v = FastBlsVerifier()
-    return v if v.native else PyBlsVerifier()
+    return v if v.native else FastBlsVerifier()
 
 
 class SimNode:

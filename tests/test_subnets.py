@@ -1,5 +1,6 @@
 """Attnets/syncnets services + metadata rotation (attnetsService.ts:31,
 network/metadata.ts; SURVEY component 28)."""
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
 
 import asyncio
 
@@ -60,8 +61,8 @@ def test_syncnets_and_metadata_served_over_reqresp():
             MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=16,
             ALTAIR_FORK_EPOCH=2**64 - 1, BELLATRIX_FORK_EPOCH=2**64 - 1,
         )
-        pool_a = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
-        pool_b = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool_a = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
+        pool_b = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         a = DevChain(MINIMAL, cfg, 16, pool_a)
         b = DevChain(MINIMAL, cfg, 16, pool_b)
         net_a = Network(MINIMAL, a.chain, GossipHandlers(a.chain))

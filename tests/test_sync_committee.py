@@ -20,7 +20,7 @@ from lodestar_tpu.chain.sync_committee_pools import (
 from lodestar_tpu.chain.validation import GossipValidationError
 from lodestar_tpu.config.chain_config import ChainConfig
 from lodestar_tpu.crypto.bls.api import interop_secret_key
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
 from lodestar_tpu.node.dev_chain import DevChain
 from lodestar_tpu.params import DOMAIN_SYNC_COMMITTEE, MINIMAL
 from lodestar_tpu.params.presets import SYNC_COMMITTEE_SUBNET_COUNT
@@ -58,7 +58,7 @@ def make_message(dev, state, vi: int, slot: int, block_root: bytes):
 
 def test_sync_message_validation_and_pools():
     async def main():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         dev = DevChain(MINIMAL, CFG, N, pool)
         await dev.run(MINIMAL.SLOTS_PER_EPOCH + 2, with_attestations=False)
         chain = dev.chain

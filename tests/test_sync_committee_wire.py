@@ -16,7 +16,7 @@ from lodestar_tpu.chain.sync_committee_pools import (
     subcommittee_assignment,
 )
 from lodestar_tpu.config.chain_config import ChainConfig
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
 from lodestar_tpu.network import Network
 from lodestar_tpu.network.peer import (
     MIN_SCORE_BEFORE_BAN,
@@ -50,8 +50,8 @@ async def wait_until(cond, timeout=20.0, interval=0.1):
 
 
 def make_pair():
-    pool_a = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
-    pool_b = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+    pool_a = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
+    pool_b = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
     a = DevChain(MINIMAL, CFG, N, pool_a)
     b = DevChain(MINIMAL, CFG, N, pool_b)
     return a, b, pool_a, pool_b

@@ -10,6 +10,7 @@ import random
 
 import pytest
 
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
 from lodestar_tpu.crypto.bls.api import (
     SecretKey,
     aggregate_signatures,
@@ -98,7 +99,7 @@ class TestTpuVerifierMatrix:
         assert not verifier.verify_signature_sets(sets)
 
     def test_differential_vs_py_verifier(self, verifier):
-        py = PyBlsVerifier()
+        py = FastBlsVerifier()
         for trial in range(4):
             sets = make_sets(3, start=trial * 3)
             if trial % 2:

@@ -5,7 +5,7 @@ import asyncio
 
 from lodestar_tpu.chain.bls_pool import BlsBatchPool
 from lodestar_tpu.config.chain_config import ChainConfig
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
 from lodestar_tpu.node.dev_chain import DevChain
 from lodestar_tpu.params import MINIMAL
 
@@ -18,7 +18,7 @@ CFG = ChainConfig(
 
 def test_monitor_tracks_inclusions_and_proposals():
     async def main():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.001)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.001)
         dev = DevChain(MINIMAL, CFG, 16, pool)
         mon = dev.chain.validator_monitor
         for i in range(16):

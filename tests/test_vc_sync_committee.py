@@ -11,7 +11,7 @@ from lodestar_tpu.api import ApiClient, RestApiServer
 from lodestar_tpu.chain.bls_pool import BlsBatchPool
 from lodestar_tpu.config.chain_config import ChainConfig
 from lodestar_tpu.crypto.bls.api import interop_secret_key
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
 from lodestar_tpu.node.dev_chain import DevChain
 from lodestar_tpu.params import MINIMAL
 from lodestar_tpu.validator import ValidatorClient, ValidatorStore
@@ -26,7 +26,7 @@ N = 16
 
 def test_vc_sync_committee_duties_flow():
     async def main():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         dev = DevChain(MINIMAL, CFG, N, pool)
         # cross the altair fork so the sync committee exists
         await dev.run(MINIMAL.SLOTS_PER_EPOCH + 2, with_attestations=False)

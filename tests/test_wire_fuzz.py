@@ -10,7 +10,7 @@ import secrets
 from lodestar_tpu.chain.bls_pool import BlsBatchPool
 from lodestar_tpu.chain.handlers import GossipHandlers
 from lodestar_tpu.config.chain_config import ChainConfig
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
 from lodestar_tpu.network import Network
 from lodestar_tpu.network.wire import write_uvarint
 from lodestar_tpu.node.dev_chain import DevChain
@@ -25,7 +25,7 @@ CFG = ChainConfig(
 
 def test_malformed_frames_do_not_kill_the_node():
     async def main():
-        pool = BlsBatchPool(PyBlsVerifier(), max_buffer_wait=0.005)
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005)
         a = DevChain(MINIMAL, CFG, 16, pool)
         net = Network(MINIMAL, a.chain, GossipHandlers(a.chain))
         port = await net.listen(0)
