@@ -16,11 +16,21 @@ Mechanics kept from the reference, retuned for a TPU dispatch:
   cannot poison its batchmates (worker.ts:78-88 retry-individually).
 - accumulation happens through JobItemQueue.drain_batch — the queue seam
   built for exactly this (utils/queue.py:99).
+
+Round-6 pipelining: the flusher keeps up to ``pipeline_depth`` merged
+batches IN FLIGHT.  Against a stage-split verifier
+(TpuBlsVerifier.verify_signature_sets_async), batch N+1 is packed and
+its device program enqueued while batch N is still computing and batch
+N-1's host final exponentiation runs — the pack/compute overlap the
+reference's BlsMultiThreadWorkerPool gets from N worker threads, rebuilt
+around ONE asynchronous device queue.  Verifiers without the async API
+get the same window via thread-pool concurrency.
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
 import time
 from typing import List, Optional, Sequence
 
@@ -41,14 +51,21 @@ class BlsBatchPool:
         max_buffer_wait: float = 0.02,
         flush_threshold: int = 128,
         max_queue_length: int = 8192,
+        pipeline_depth: int = 2,
         metrics=None,
     ):
         self.verifier = verifier
         self.max_buffer_wait = max_buffer_wait
         self.flush_threshold = flush_threshold
+        self.pipeline_depth = max(1, pipeline_depth)
         self.metrics = metrics
+        # stage-split verifiers observe their pack/final-exp histograms on
+        # the same registry
+        if metrics is not None and getattr(verifier, "metrics", "no") is None:
+            verifier.metrics = metrics
         self.batch_retries = 0
         self.batch_sets_success = 0
+        self.inflight_peak = 0
         # max_concurrency=0: jobs are never auto-scheduled; the flusher is
         # the only consumer, via drain_batch.
         self._queue: JobItemQueue[List[SignatureSet], bool] = JobItemQueue(
@@ -112,22 +129,70 @@ class BlsBatchPool:
             asyncio.get_running_loop().create_task(self._flush())
 
     async def _flush(self) -> None:
+        """Pipelined drain: keep up to ``pipeline_depth`` merged batches in
+        flight.  The fill half packs + enqueues batch N+1 (host CPU work on
+        a worker thread; the device dispatch itself is async) while the
+        drain half reads back the OLDEST in-flight batch's verdict — so the
+        host final exponentiation of batch N runs concurrently with the
+        device compute of batch N+1."""
         self._flushing = True
+        use_async = hasattr(self.verifier, "verify_signature_sets_async")
+        inflight: collections.deque = collections.deque()
         try:
-            while len(self._queue):
-                jobs = self._queue.drain_batch(max_items=1024)
-                if not jobs:
+            while len(self._queue) or inflight:
+                # fill the window
+                while len(self._queue) and len(inflight) < self.pipeline_depth:
+                    jobs = self._queue.drain_batch(max_items=1024)
+                    if not jobs:
+                        break
+                    merged: List[SignatureSet] = []
+                    for item, _fut in jobs:
+                        merged.extend(item)
+                    if self.metrics:
+                        self.metrics.bls_pool_dispatches_total.inc()
+                        self.metrics.bls_pool_batch_size.observe(len(merged))
+                    try:
+                        if use_async:
+                            # pack on a worker thread; returns once the
+                            # device program is ENQUEUED, not finished
+                            pending = await asyncio.to_thread(
+                                self.verifier.verify_signature_sets_async, merged
+                            )
+                            verdict = asyncio.create_task(
+                                asyncio.to_thread(pending.result)
+                            )
+                        else:
+                            verdict = asyncio.create_task(
+                                asyncio.to_thread(
+                                    self.verifier.verify_signature_sets, merged
+                                )
+                            )
+                    except Exception as e:  # noqa: BLE001
+                        # a pack/enqueue failure must NOT strand the drained
+                        # jobs' futures: feed a failed verdict through the
+                        # normal drain half so the per-job retry resolves
+                        # every caller
+                        logger.warning(
+                            "dispatch enqueue failed: %s; will retry per job", e
+                        )
+                        verdict = asyncio.get_running_loop().create_future()
+                        verdict.set_result(False)
+                    inflight.append((jobs, merged, verdict, time.monotonic()))
+                    self.inflight_peak = max(self.inflight_peak, len(inflight))
+                    if self.metrics:
+                        self.metrics.bls_pool_inflight_depth.set(len(inflight))
+                if not inflight:
                     return
-                merged: List[SignatureSet] = []
-                for item, _fut in jobs:
-                    merged.extend(item)
-                if self.metrics:
-                    self.metrics.bls_pool_dispatches_total.inc()
-                    self.metrics.bls_pool_batch_size.observe(len(merged))
-                t0 = time.monotonic()
-                ok = await asyncio.to_thread(self.verifier.verify_signature_sets, merged)
+                # drain the oldest batch
+                jobs, merged, verdict, t0 = inflight.popleft()
+                try:
+                    ok = await verdict
+                except Exception as e:  # noqa: BLE001
+                    logger.warning("merged dispatch raised: %s; retrying per job", e)
+                    ok = False
                 if self.metrics:
                     self.metrics.bls_pool_dispatch_seconds.observe(time.monotonic() - t0)
+                    self.metrics.bls_pool_inflight_depth.set(len(inflight))
                 if ok:
                     self.batch_sets_success += len(merged)
                     for _item, fut in jobs:
@@ -149,5 +214,7 @@ class BlsBatchPool:
                     fut.set_result(one)
         finally:
             self._flushing = False
+            if self.metrics:
+                self.metrics.bls_pool_inflight_depth.set(0)
             if len(self._queue):
                 self._buffered_sets_changed()

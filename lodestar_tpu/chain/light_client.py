@@ -108,7 +108,16 @@ class LightClientServer:
         # header carries the freshest finalized header — an early-period
         # update's finality can predate a client's bootstrap entirely
         new_rel = sync_period_at_slot(self.p, block.slot) == period
-        new_fin = bytes(attested_state.finalized_checkpoint.root) != b"\x00" * 32
+        # finality-bearing only when the finalized BLOCK is present in the
+        # store (ADVICE r5): _build_update serves an empty finality_branch
+        # when it cannot materialize the finalized header, and an
+        # empty-branch candidate must not win the is_better_update cascade
+        # on the finality tiebreak
+        fin_cp = attested_state.finalized_checkpoint
+        new_fin = (
+            bytes(fin_cp.root) != b"\x00" * 32
+            and self.chain.get_block_by_root(bytes(fin_cp.root)) is not None
+        )
         cur = self.best_update_by_period.get(period)
         if cur is not None:
             max_bits = len(agg.sync_committee_bits)

@@ -82,6 +82,41 @@ def build_parser() -> argparse.ArgumentParser:
             "is present, else native C, else pure python) — the selection "
             "seam of chain/chain.ts:146-148",
         )
+        p.add_argument(
+            "--bls-buckets", default="4,16,64,128,256",
+            help="padding bucket sizes for the batched TPU dispatch "
+            "(comma-separated; one compiled program per bucket)",
+        )
+        p.add_argument(
+            "--bls-pipeline-depth", type=int, default=2,
+            help="merged batches kept in flight on the device pipeline "
+            "(pack N+1 while N computes and N-1 finishes on the host)",
+        )
+        p.add_argument(
+            "--bls-flush-threshold", type=int, default=128,
+            help="buffered signature sets that trigger an immediate flush",
+        )
+        p.add_argument(
+            "--bls-buffer-wait-ms", type=float, default=20.0,
+            help="max time a batchable job waits to share a dispatch "
+            "(MAX_BUFFER_WAIT_MS analog)",
+        )
+        p.add_argument(
+            "--bls-warmup", choices=("background", "blocking", "off"),
+            default="background",
+            help="AOT-compile every bucket's program at startup so the "
+            "first block import doesn't eat a cold compile",
+        )
+        p.add_argument(
+            "--bls-fused", choices=("auto", "on", "off"), default="auto",
+            help="fused Pallas kernel path (auto: on only on real TPU "
+            "backends; off: portable XLA-graph kernels)",
+        )
+        p.add_argument(
+            "--bls-cache-dir", default=None,
+            help="persistent XLA compilation cache directory "
+            "(default: $LODESTAR_TPU_JAX_CACHE or repo-local .jax_cache)",
+        )
 
     dev = sub.add_parser("dev", help="single-process interop chain (cmds/dev)")
     common(dev)
@@ -161,22 +196,23 @@ def build_parser() -> argparse.ArgumentParser:
 
 async def run_dev(args) -> int:
     from .api import RestApiServer
-    from .chain.bls_pool import BlsBatchPool
     from .chain.handlers import GossipHandlers
     from .chain.light_client import LightClientServer
     from .crypto.bls.verifier import PyBlsVerifier
     from .db.beacon import BeaconDb
     from .db.controller import MemoryDbController, SqliteDbController
-    from .metrics.registry import MetricsRegistry
+    from .metrics import create_metrics
     from .network import Network
     from .node.dev_chain import DevChain
 
     preset = _preset(args.preset)
     cfg = _chain_config(args)
-    pool = BlsBatchPool(_make_verifier(args))
+    # full Metrics group (not just the registry) so the pool/verifier
+    # observe the new pipeline-stage histograms in dev mode too
+    metrics = create_metrics() if args.metrics else None
+    pool = _make_pool(args, metrics=metrics)
     controller = SqliteDbController(args.db) if args.db else MemoryDbController()
     db = BeaconDb(preset, controller)
-    metrics = MetricsRegistry() if args.metrics else None
     dev = DevChain(preset, cfg, args.validators, pool, db=db)
     handlers = GossipHandlers(dev.chain)
     lc_server = LightClientServer(preset, dev.chain)
@@ -185,7 +221,8 @@ async def run_dev(args) -> int:
     for target in args.connect:
         host, _, port = target.partition(":")
         await network.connect(host, int(port))
-    rest = RestApiServer(preset, dev.chain, network=network, metrics_registry=metrics)
+    rest = RestApiServer(preset, dev.chain, network=network,
+                         metrics_registry=metrics.reg if metrics else None)
     rest.gossip_handlers = handlers
     rest.light_client_server = lc_server
     await rest.listen(args.rest_port)
@@ -208,6 +245,20 @@ async def run_dev(args) -> int:
     return 0
 
 
+def _make_pool(args, metrics=None):
+    """Verifier + batch pool with the dispatch-pipeline knobs applied
+    (docs/dispatch_pipeline.md)."""
+    from .chain.bls_pool import BlsBatchPool
+
+    return BlsBatchPool(
+        _make_verifier(args),
+        max_buffer_wait=getattr(args, "bls_buffer_wait_ms", 20.0) / 1e3,
+        flush_threshold=getattr(args, "bls_flush_threshold", 128),
+        pipeline_depth=getattr(args, "bls_pipeline_depth", 2),
+        metrics=metrics,
+    )
+
+
 def _make_verifier(args):
     """The verifier selection seam (reference chain.ts:146-148 picks the
     worker pool by default; here: TPU kernel by default when a TPU backend
@@ -223,10 +274,23 @@ def _make_verifier(args):
         except Exception:
             choice = "native"
     if choice == "tpu":
-        from .crypto.bls.tpu_verifier import TpuBlsVerifier
+        from .crypto.bls.tpu_verifier import TpuBlsVerifier, configure_persistent_cache
 
+        configure_persistent_cache(getattr(args, "bls_cache_dir", None))
+        buckets = tuple(
+            int(b) for b in str(getattr(args, "bls_buckets", "4,16,64,128,256")).split(",") if b
+        )
+        fused_flag = getattr(args, "bls_fused", "auto")
+        fused = None if fused_flag == "auto" else fused_flag == "on"
+        v = TpuBlsVerifier(buckets=buckets, fused=fused)
+        warm = getattr(args, "bls_warmup", "background")
+        if warm == "blocking":
+            dt = v.warmup()
+            logger.info("bls AOT warmup: %d buckets in %.1fs", len(buckets), dt)
+        elif warm == "background":
+            v.warmup_async()
         logger.info("bls verifier: TPU batched kernel (host final exp)")
-        return TpuBlsVerifier()
+        return v
     if choice == "native":
         from .crypto.bls.native_verifier import FastBlsVerifier
 
@@ -247,7 +311,6 @@ async def run_beacon(args) -> int:
     Reference: cmds/beacon/handler.ts + initBeaconState.ts:104-136."""
     from .api import RestApiServer
     from .chain.beacon_chain import BeaconChain
-    from .chain.bls_pool import BlsBatchPool
     from .chain.handlers import GossipHandlers
     from .crypto.bls.verifier import PyBlsVerifier
     from .db.beacon import BeaconDb
@@ -277,7 +340,10 @@ async def run_beacon(args) -> int:
     else:
         resumed = db.last_archived_state()
         genesis = resumed or interop_genesis_state(preset, cfg, args.validators, 1)
-    pool = BlsBatchPool(_make_verifier(args))
+    from .metrics import create_metrics
+
+    metrics = create_metrics()
+    pool = _make_pool(args, metrics=metrics)
     execution_engine = None
     if args.execution_url:
         from urllib.parse import urlparse as _urlparse
@@ -305,9 +371,6 @@ async def run_beacon(args) -> int:
             pubkey=_hex_bytes(args.builder_pubkey, 48, "--builder-pubkey")
             if args.builder_pubkey else None,
         )
-    from .metrics import create_metrics
-
-    metrics = create_metrics()
     chain = BeaconChain(
         preset, cfg, genesis, pool, db=db, metrics=metrics,
         execution_engine=execution_engine, builder=builder,
@@ -496,6 +559,7 @@ async def run_lightclient(args) -> int:
     api = ApiClient(host, port)
     genesis = await api.get("/eth/v1/beacon/genesis")
     gvr = bytes.fromhex(genesis["data"]["genesis_validators_root"][2:])
+    genesis_time = int(genesis["data"].get("genesis_time", 0))
     root = args.checkpoint_root
     if not root:
         fc = await api.get("/eth/v1/beacon/states/head/finality_checkpoints")
@@ -507,6 +571,15 @@ async def run_lightclient(args) -> int:
     slots_per_period = preset.SLOTS_PER_EPOCH * preset.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
     while args.max_polls == 0 or polls < args.max_polls:
         polls += 1
+        # clock-driven per-period hook: rotate the participation
+        # watermarks even when no update crosses the period boundary
+        import time as _time
+
+        if genesis_time:
+            wall_slot = max(
+                0, int(_time.time() - genesis_time) // cfg.SECONDS_PER_SLOT
+            )
+            client.process_slot(wall_slot)
         try:
             # resume from the period of our best header so the follow loop
             # advances with the chain instead of refetching period 0

@@ -89,6 +89,10 @@ class LightClient:
         self.best_valid_update = None
         self.previous_max_active_participants = 0
         self.current_max_active_participants = 0
+        # the sync-committee period the watermarks currently describe —
+        # rotation is keyed on this so the clock hook (process_slot) and
+        # the update path (_apply) rotate at most once per period
+        self._participants_period = self._sync_period(bootstrap.header.slot)
         # verify the bootstrap proof against the trusted header state root
         st_alt = self.t.altair
         leaf = st_alt.SyncCommittee.hash_tree_root(bootstrap.current_sync_committee)
@@ -108,6 +112,33 @@ class LightClient:
     def _field_index(self, name: str) -> int:
         fields = [f for f, _ in self.t.altair.BeaconState.fields]
         return fields.index(name)
+
+    def _rotate_participants(self, period: int) -> None:
+        """Roll the previous/current max-participation watermarks forward
+        to ``period`` (idempotent; a multi-period gap clears both)."""
+        if period <= self._participants_period:
+            return
+        if period == self._participants_period + 1:
+            self.previous_max_active_participants = (
+                self.current_max_active_participants
+            )
+        else:
+            self.previous_max_active_participants = 0
+        self.current_max_active_participants = 0
+        self._participants_period = period
+
+    def process_slot(self, current_slot: int) -> None:
+        """Clock-driven per-period hook (ADVICE r5): rotate the
+        participation watermarks when the WALL CLOCK crosses into a new
+        sync-committee period — keyed on
+        compute_sync_committee_period(current_slot), not only on the
+        update path (_apply).  Without this, a store that stops receiving
+        period-crossing finalized updates keeps an ancient
+        current_max_active_participants and the optimistic safety
+        threshold (max of the two watermarks / 2) can hold the head back
+        forever.  Drive it once per slot (or per poll) from the follow
+        loop."""
+        self._rotate_participants(self._sync_period(int(current_slot)))
 
     # -- validation (spec validate_light_client_update) ------------------------
 
@@ -354,10 +385,9 @@ class LightClient:
             self.next_sync_committee = (
                 update.next_sync_committee if _has_sync_committee(update) else None
             )
-            self.previous_max_active_participants = (
-                self.current_max_active_participants
-            )
-            self.current_max_active_participants = 0
+            # watermark rotation shares the clock hook's idempotent path:
+            # if process_slot already rolled this period, don't double-clear
+            self._rotate_participants(new_period)
         elif new_period > store_period + 1:
             raise LightClientError("update skips a sync-committee period")
         if fin.slot > self.finalized_header.slot:

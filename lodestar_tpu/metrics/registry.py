@@ -109,6 +109,21 @@ class Metrics:
             "time a set waits in the buffer before dispatch",
             buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1),
         )
+        # pipelined dispatch stages (round-6: pack -> device -> final exp)
+        self.bls_pool_pack_seconds = r.histogram(
+            "lodestar_bls_pool_pack_seconds",
+            "host packing stage (bytes -> limb arrays) per dispatch",
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5),
+        )
+        self.bls_pool_final_exp_seconds = r.histogram(
+            "lodestar_bls_pool_final_exp_seconds",
+            "device readback + host final exponentiation per dispatch",
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1),
+        )
+        self.bls_pool_inflight_depth = r.gauge(
+            "lodestar_bls_pool_inflight_depth",
+            "merged batches concurrently in flight on the device pipeline",
+        )
         # chain
         self.block_processing_seconds = r.histogram(
             "lodestar_block_processing_seconds",

@@ -98,7 +98,10 @@ class ValidatorMonitor:
                 prev = self._att_inclusion[epoch].get(vi)
                 if prev is None or delay < prev.delay:
                     self._att_inclusion[epoch][vi] = rec
-                    if self.metrics and prev is None:
+                    # observe on REPLACEMENT too (ADVICE r5): a later block
+                    # carrying a lower-delay inclusion is the record the
+                    # dashboards should reflect, not only the first sight
+                    if self.metrics:
                         self.metrics.monitor_inclusion_delay.observe(delay)
                         if target_correct:
                             self.metrics.monitor_timely_total.labels(

@@ -163,11 +163,55 @@ def lselect(cond: jnp.ndarray, x: LV, y: LV) -> LV:
 
 
 def lstack(vals, axis: int) -> LV:
+    """Stack LVs on a new axis.
+
+    More than 16 lanes route through the offset-0 aligned splice:
+    jnp.stack chunks >16 operands into concatenates of MIXED chunk widths
+    (16 + remainder, e.g. the 18-lane f12_mul stack becomes
+    (..., 16, 2, 50) ++ (..., 2, 2, 50)) whose concat-adjacent dims sit
+    below the (8, 128) tile — the narrow mixed-width splice Mosaic cannot
+    retile.  At <= 16 lanes the single uniform concatenate is fine."""
+    if len(vals) > 16:
+        arrs = [jnp.expand_dims(v.a, axis) for v in vals]
+        return LV(aligned_splice(arrs, axis), max(v.b for v in vals))
     return LV(jnp.stack([v.a for v in vals], axis=axis), max(v.b for v in vals))
 
 
+def aligned_splice(arrs, axis: int = 0) -> jnp.ndarray:
+    """Concatenation expressed as offset-0 zero-pads + adds (bool: ors).
+
+    Mosaic cannot retile a ``tpu.concatenate`` whose operands sit at a
+    nonzero sublane/lane offset when the concat-adjacent dims are below
+    the (8, 128) vreg tile — the round-5 bench failure was exactly such a
+    splice ("result/input offset mismatch on non-concat dimension",
+    vector<256x50xf32> ++ vector<256x2xf32>).  Padding every operand to
+    the full output extent keeps each one at offset 0 (the
+    ops/pallas_tower.py convention); the operands' supports are disjoint,
+    so the elementwise sum IS the concatenation, exactly, and the cost is
+    a handful of vector adds.
+    """
+    ax = axis % arrs[0].ndim
+    total = sum(a.shape[ax] for a in arrs)
+    off = 0
+    acc = None
+    for a in arrs:
+        cfg = [(0, 0)] * a.ndim
+        cfg[ax] = (off, total - off - a.shape[ax])
+        p = jnp.pad(a, cfg)
+        if acc is None:
+            acc = p
+        elif acc.dtype == jnp.bool_:
+            acc = acc | p
+        else:
+            acc = acc + p
+        off += a.shape[ax]
+    return acc
+
+
 def lconcat(vals, axis: int) -> LV:
-    return LV(jnp.concatenate([v.a for v in vals], axis=axis), max(v.b for v in vals))
+    """LV concatenation via the offset-0 aligned splice (disjoint row
+    supports: the digit bound is the max, not the sum)."""
+    return LV(aligned_splice([v.a for v in vals], axis), max(v.b for v in vals))
 
 
 # Fq2 component access on (..., 2, 50) LVs

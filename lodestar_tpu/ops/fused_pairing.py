@@ -29,7 +29,20 @@ from jax import lax
 from ..crypto.bls.fields import BLS_X
 from . import limbs as fl
 from . import tower as tw
-from .fused_core import LV, f2_mul, ladd, lc, lcast, ldbl, lneg, lselect, lstack, lsub, lv
+from .fused_core import (
+    LV,
+    f2_mul,
+    ladd,
+    lc,
+    lcast,
+    lconcat,
+    ldbl,
+    lneg,
+    lselect,
+    lstack,
+    lsub,
+    lv,
+)
 from .fused_field import (
     f12_conj,
     f12_cyc_sqr,
@@ -224,21 +237,27 @@ def final_exponentiation(f: LV, interpret=None) -> LV:
 def multi_miller_product(xp, yp, xq, yq, mask, interpret=None) -> LV:
     """prod_i f_i over the leading batch axis, masked entries contributing 1
     (pairing.multi_miller_product): one shared final exponentiation
-    amortizes over the batch."""
+    amortizes over the batch.
+
+    The batch is padded to the next power of two with FQ12_ONE rows ONCE,
+    up front, through the offset-0 aligned splice — the old per-level
+    odd-size concatenate put the pad row at sublane offset n with (6,2,50)
+    trailing dims, the narrow-width retile Mosaic rejects (fused_core
+    aligned_splice)."""
     f = miller_loop(xp, yp, xq, yq, interpret)
     one = lv(
         jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), f.a.shape).astype(jnp.float32)
     )
     f = f12_select(mask, f, one)
+    n = f.a.shape[0]
+    npow = 1 << max(0, (n - 1).bit_length())
+    if npow != n:
+        pad = jnp.broadcast_to(
+            jnp.asarray(tw.FQ12_ONE), (npow - n,) + f.a.shape[1:]
+        ).astype(jnp.float32)
+        f = lconcat([f, LV(pad, 256)], axis=0)
     while f.a.shape[0] > 1:
-        n = f.a.shape[0]
-        if n % 2:
-            pad = jnp.broadcast_to(
-                jnp.asarray(tw.FQ12_ONE), (1,) + f.a.shape[1:]
-            ).astype(jnp.float32)
-            f = LV(jnp.concatenate([f.a, pad]), f.b)
-            n += 1
-        half = n // 2
+        half = f.a.shape[0] // 2
         f = f12_mul(LV(f.a[:half], f.b), LV(f.a[half:], f.b), interpret)
     return LV(f.a[0], f.b)
 

@@ -33,6 +33,7 @@ from .fused_core import (
     ladd,
     lc,
     lcast,
+    lconcat,
     ldbl,
     lneg,
     lselect,
@@ -274,7 +275,11 @@ def point_sum_tree(p: Point, ns: FNS) -> Point:
 
 
 def lconcat_pair(x: LV, y: LV) -> LV:
-    return LV(jnp.concatenate([x.a, y.a]), max(x.b, y.b))
+    """Batch-axis splice via the offset-0 aligned form: a plain
+    concatenate here puts y at sublane offset N with trailing dims below
+    the (8, 128) tile — the retile Mosaic cannot do (fused_core
+    aligned_splice)."""
+    return lconcat([x, y], axis=0)
 
 
 def point_eq(p: Point, q: Point, ns: FNS, interpret=None):

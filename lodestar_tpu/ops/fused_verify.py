@@ -32,7 +32,19 @@ import jax.numpy as jnp
 from ..crypto.bls.fields import BLS_X
 from . import limbs as fl
 from . import tower as tw
-from .fused_core import LV, f2_mul, f_canon, f_mul, ladd, lneg, lselect, lstack, lv
+from .fused_core import (
+    LV,
+    aligned_splice,
+    f2_mul,
+    f_canon,
+    f_mul,
+    ladd,
+    lconcat,
+    lneg,
+    lselect,
+    lstack,
+    lv,
+)
 from .fused_field import f2_is_zero, fi_inv
 from .fused_htc import hash_to_g2_pre_cofactor
 from .fused_pairing import final_exponentiation, multi_miller_product, f12_is_one
@@ -169,17 +181,19 @@ def miller_product_fused(
     )
 
     # --- merged affine conversion: one Fermat scan for every inversion ---
+    # every batch-axis splice below rides the offset-0 aligned splice
+    # (fused_core.aligned_splice): the trailing (2, 50)/(50,) extents sit
+    # below the (8, 128) tile, so a plain concatenate at sublane offset N
+    # is exactly the retile Mosaic rejects (BENCH_r05 rc=124)
     g2_stack = tuple(
-        LV(jnp.concatenate([h_jac[i].a, s_sum[i].a[None]]), max(h_jac[i].b, s_sum[i].b))
+        lconcat([h_jac[i], LV(s_sum[i].a[None], s_sum[i].b)], axis=0)
         for i in range(3)
     )
     zg2 = g2_stack[2]
     z0, z1 = LV(zg2.a[..., 0, :], zg2.b), LV(zg2.a[..., 1, :], zg2.b)
     compsq = f_mul(lstack([z0, z1], -2), lstack([z0, z1], -2), interpret)
     norm = ladd(LV(compsq.a[..., 0, :], compsq.b), LV(compsq.a[..., 1, :], compsq.b))
-    inv_in = LV(
-        jnp.concatenate([norm.a, pk_scaled[2].a]), max(norm.b, pk_scaled[2].b)
-    )  # (2N+1, 50)
+    inv_in = lconcat([norm, pk_scaled[2]], axis=0)  # (2N+1, 50)
     inv_all = fi_inv(inv_in, interpret)
     ninv2 = LV(inv_all.a[: n + 1], inv_all.b)
     zinv_g1 = LV(inv_all.a[n + 1 :], inv_all.b)
@@ -192,10 +206,10 @@ def miller_product_fused(
     # pair list: (c_i pk_i, H_i) for live lanes, then (-g1, S)
     neg_x = lv(jnp.asarray(G1_GEN_NEG_AFFINE[0]))
     neg_y = lv(jnp.asarray(G1_GEN_NEG_AFFINE[1]))
-    xp = LV(jnp.concatenate([pk_aff_x.a, neg_x.a[None]]), max(pk_aff_x.b, 256))
-    yp = LV(jnp.concatenate([pk_aff_y.a, neg_y.a[None]]), max(pk_aff_y.b, 256))
+    xp = lconcat([pk_aff_x, LV(neg_x.a[None], 256)], axis=0)
+    yp = lconcat([pk_aff_y, LV(neg_y.a[None], 256)], axis=0)
     s_not_inf = ~f2_is_zero(s_sum[2], interpret)
-    pair_mask = jnp.concatenate([mask, s_not_inf[None]], axis=0)
+    pair_mask = aligned_splice([mask, s_not_inf[None]], axis=0)
 
     f = multi_miller_product(xp, yp, g2_aff_x, g2_aff_y, pair_mask, interpret)
     return f, subgroup_ok & jnp.any(mask)

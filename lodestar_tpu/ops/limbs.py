@@ -91,8 +91,24 @@ def int_to_limbs(v: int, width: int = NLIMBS) -> np.ndarray:
 
 
 def ints_to_limbs(vals: Sequence[int], width: int = NLIMBS) -> np.ndarray:
-    """Batch of Python ints -> (N, width) float32 digit array (host side)."""
-    return np.stack([int_to_limbs(v, width) for v in vals])
+    """Batch of Python ints -> (N, width) float32 digit array (host side).
+
+    One bulk byte conversion instead of a per-digit Python loop: an 8-bit
+    limb IS one little-endian byte, so the whole batch converts as
+    int.to_bytes + one numpy view + one cast — the packing hot path of
+    TpuBlsVerifier (50 Python shift/mask ops per element otherwise).
+    """
+    if not len(vals):
+        return np.zeros((0, width), dtype=NP_DTYPE)
+    try:
+        blob = b"".join(int(v).to_bytes(width, "little") for v in vals)
+    except OverflowError as e:
+        raise ValueError("value does not fit width") from e
+    return (
+        np.frombuffer(blob, dtype=np.uint8)
+        .reshape(len(vals), width)
+        .astype(NP_DTYPE)
+    )
 
 
 def limbs_to_int(limbs) -> int:

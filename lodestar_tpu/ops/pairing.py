@@ -261,16 +261,24 @@ def multi_miller_product(xp, yp, xq, yq, mask):
     f = miller_loop(xp, yp, xq, yq)  # (N, ..., 6, 2, 50)
     one = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), f.shape).astype(fl.DTYPE)
     f = tw.fq12_select(mask, f, one)
-    # pairwise product tree over axis 0
+    # pairwise product tree over axis 0, padded to a power of two ONCE
+    # with FQ12_ONE rows through an offset-0 aligned splice (zero-pad both
+    # operands to the full extent and add — disjoint supports, exact).
+    # The old per-level odd-size concatenate spliced a single (6,2,50) row
+    # at sublane offset n, the narrow-width retile Mosaic rejects when
+    # this graph is inlined into a fused TPU program (BENCH_r05 rc=124).
+    n = f.shape[0]
+    npow = 1 << max(0, (n - 1).bit_length())
+    if npow != n:
+        pad = jnp.pad(
+            jnp.broadcast_to(
+                jnp.asarray(tw.FQ12_ONE), (npow - n,) + f.shape[1:]
+            ).astype(fl.DTYPE),
+            [(n, 0)] + [(0, 0)] * (f.ndim - 1),
+        )
+        f = jnp.pad(f, [(0, npow - n)] + [(0, 0)] * (f.ndim - 1)) + pad
     while f.shape[0] > 1:
-        n = f.shape[0]
-        if n % 2:
-            pad = jnp.broadcast_to(
-                jnp.asarray(tw.FQ12_ONE), (1,) + f.shape[1:]
-            ).astype(fl.DTYPE)
-            f = jnp.concatenate([f, pad])
-            n += 1
-        half = n // 2
+        half = f.shape[0] // 2
         f = tw.fq12_mul(f[:half], f[half:])
     return f[0]
 

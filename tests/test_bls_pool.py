@@ -100,6 +100,136 @@ class TestPool:
         run(main())
 
 
+class StageVerifier:
+    """Stage-split fake with deterministic latencies: pack blocks the
+    calling thread, the 'device' computes in wall time after dispatch, and
+    result() blocks until the device is done then pays the host final-exp
+    cost — the TpuBlsVerifier timing shape without a TPU."""
+
+    PACK_S = 0.05
+    DEVICE_S = 0.10
+    FINAL_S = 0.05
+
+    def __init__(self, verdict_fn=None):
+        self.dispatched = 0
+        self.verdict_fn = verdict_fn or (lambda sets: True)
+
+    def verify_signature_sets_async(self, sets):
+        import time as _t
+
+        _t.sleep(self.PACK_S)  # host packing
+        self.dispatched += 1
+        ready_at = _t.monotonic() + self.DEVICE_S  # async device compute
+        verdict = self.verdict_fn(sets)
+
+        class _Pending:
+            def result(_self):
+                rem = ready_at - _t.monotonic()
+                if rem > 0:
+                    _t.sleep(rem)  # device sync
+                _t.sleep(self.FINAL_S)  # host final exponentiation
+                return verdict
+
+        return _Pending()
+
+    def verify_signature_sets(self, sets):
+        return self.verify_signature_sets_async(sets).result()
+
+
+class TestPipeline:
+    def test_pack_overlaps_dispatch_with_three_batches(self):
+        """Acceptance: with >=3 queued batches the pipelined flush beats
+        the serial sum and >=2 batches are concurrently in flight."""
+
+        async def main():
+            import time as _t
+
+            v = StageVerifier()
+            metrics = create_metrics()
+            pool = BlsBatchPool(
+                v, max_buffer_wait=0.005, pipeline_depth=3, metrics=metrics
+            )
+            depth_seen = []
+
+            async def watch():
+                while True:
+                    try:
+                        depth_seen.append(
+                            metrics.bls_pool_inflight_depth._value.get()
+                        )
+                    except AttributeError:  # prometheus absent -> noop metric
+                        depth_seen.append(pool.inflight_peak)
+                    await asyncio.sleep(0.004)
+
+            watcher = asyncio.create_task(watch())
+            t0 = _t.monotonic()
+            # stagger pushes so the flusher drains three separate batches:
+            # each lands while the previous batch is still being packed
+            jobs = [asyncio.create_task(pool.verify_signature_sets([make_set(0)]))]
+            for i in (1, 2):
+                await asyncio.sleep(StageVerifier.PACK_S * 0.9)
+                jobs.append(
+                    asyncio.create_task(pool.verify_signature_sets([make_set(i)]))
+                )
+            results = await asyncio.gather(*jobs)
+            wall = _t.monotonic() - t0
+            watcher.cancel()
+            assert results == [True] * 3
+            assert v.dispatched == 3
+            serial = 3 * (
+                StageVerifier.PACK_S + StageVerifier.DEVICE_S + StageVerifier.FINAL_S
+            )
+            assert wall < serial, f"no overlap: wall {wall:.3f}s vs serial {serial:.3f}s"
+            assert pool.inflight_peak >= 2
+            assert max(depth_seen, default=0) >= 2, depth_seen
+            pool.close()
+
+        run(main())
+
+    def test_coalescing_fewer_dispatches_than_jobs(self):
+        """flush-threshold vs max-buffer-wait: concurrent pushes share
+        dispatches (dispatches < jobs_submitted)."""
+
+        async def main():
+            v = CountingVerifier()
+            pool = BlsBatchPool(v, max_buffer_wait=0.02, flush_threshold=64)
+            jobs = []
+            for wave in range(4):
+                jobs += [
+                    pool.verify_signature_sets([make_set(8 * wave + i)])
+                    for i in range(8)
+                ]
+                await asyncio.sleep(0.002)
+            results = await asyncio.gather(*jobs)
+            assert results == [True] * 32
+            assert len(v.calls) < 32, v.calls  # merged dispatches
+            assert sum(v.calls) == 32  # every set verified exactly once
+            pool.close()
+
+        run(main())
+
+    def test_retry_individually_on_pipelined_path(self):
+        """A poisoned merged batch on the ASYNC path still resolves every
+        innocent job (worker.ts:78-88 semantics through the pipeline)."""
+
+        async def main():
+            truth = PyBlsVerifier()
+            v = StageVerifier(verdict_fn=truth.verify_signature_sets)
+            v.PACK_S = v.DEVICE_S = v.FINAL_S = 0.001
+            pool = BlsBatchPool(v, max_buffer_wait=0.01, pipeline_depth=2)
+            jobs = [
+                pool.verify_signature_sets([make_set(0)]),
+                pool.verify_signature_sets([make_set(1, valid=False)]),
+                pool.verify_signature_sets([make_set(2)]),
+            ]
+            results = await asyncio.gather(*jobs)
+            assert results == [True, False, True]
+            assert pool.batch_retries == 1
+            pool.close()
+
+        run(main())
+
+
 class TestUtilsExtras:
     def test_logger_children(self):
         from lodestar_tpu.utils.logger import get_logger

@@ -61,6 +61,21 @@ class TestPacking:
         with pytest.raises(ValueError):
             fl.int_to_limbs(-1)
 
+    def test_batch_matches_scalar(self):
+        # the vectorized byte->limb path (TpuBlsVerifier packing hot path)
+        # is bit-identical to the per-digit scalar reference
+        vals = rand_ints(20, 1 << fl.VALUE_BITS) + adversarial_ints()
+        got = fl.ints_to_limbs(vals)
+        want = np.stack([fl.int_to_limbs(v) for v in vals])
+        assert got.dtype == want.dtype and (got == want).all()
+        assert fl.ints_to_limbs([]).shape == (0, fl.NLIMBS)
+
+    def test_batch_out_of_range(self):
+        with pytest.raises(ValueError):
+            fl.ints_to_limbs([1, 1 << fl.VALUE_BITS])
+        with pytest.raises(ValueError):
+            fl.ints_to_limbs([-1])
+
 
 class TestRing:
     def test_add_strict_chain(self):

@@ -11,7 +11,8 @@ from lodestar_tpu.chain.prepare_next_slot import (
     ReprocessController,
 )
 from lodestar_tpu.config.chain_config import ChainConfig
-from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier
+from lodestar_tpu.crypto.bls.native_verifier import FastBlsVerifier
+from lodestar_tpu.crypto.bls.verifier import PyBlsVerifier  # noqa: F401
 from lodestar_tpu.node.dev_chain import DevChain
 from lodestar_tpu.params import MINIMAL
 

@@ -114,6 +114,50 @@ class TestTpuVerifierMatrix:
         assert verifier.sets_verified >= 2
 
 
+class TestPipelineApi:
+    """The round-6 stage-split surface: pack / dispatch / PendingVerdict."""
+
+    def test_async_returns_pending_then_verdict(self, verifier):
+        pending = verifier.verify_signature_sets_async(make_sets(2))
+        assert not pending.done_hint()
+        assert pending.result() is True
+        assert pending.done_hint()
+        assert pending.result() is True  # idempotent
+
+    def test_async_malformed_short_circuits_without_dispatch(self, verifier):
+        sets = make_sets(1)
+        sets[0].signature = b"\xff" * 96
+        before = verifier.dispatches
+        pending = verifier.verify_signature_sets_async(sets)
+        assert pending.done_hint() and pending.result() is False
+        assert verifier.dispatches == before  # pack rejected, nothing enqueued
+
+    def test_async_oversized_batch_chunks_back_to_back(self, verifier):
+        before = verifier.dispatches
+        pending = verifier.verify_signature_sets_async(make_sets(10))
+        # both chunks enqueued before any sync
+        assert verifier.dispatches == before + 2
+        assert pending.result() is True
+
+    def test_stage_seconds_accumulate(self, verifier):
+        pack0 = verifier.stage_seconds["pack"]
+        fexp0 = verifier.stage_seconds["final_exp"]
+        assert verifier.verify_signature_sets(make_sets(2))
+        assert verifier.stage_seconds["pack"] > pack0
+        assert verifier.stage_seconds["final_exp"] > fexp0
+
+    @pytest.mark.slow
+    def test_warmup_aot_compiles_bucket(self):
+        v = TpuBlsVerifier(buckets=(4,))
+        dt = v.warmup()
+        assert dt >= 0 and v.stage_seconds["warmup"] >= dt
+        # the AOT executable (not a jit wrapper) serves the dispatch
+        key = (4, v.host_final_exp, v.fused)
+        assert key in v._compiled and not hasattr(v._compiled[key], "lower")
+        assert v.verify_signature_sets(make_sets(2))
+        v.close()
+
+
 class TestAdversarial:
     def test_non_subgroup_signature_rejected(self, verifier):
         # forge bytes for an on-curve, non-subgroup G2 point
