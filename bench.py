@@ -339,8 +339,14 @@ def bench_dev_chain(time_budget_s: float = 150.0):
             await dev.advance_slot(slot)
         rate = n / (_t.perf_counter() - t1)
         pool.close()
-        return rate
+        return {
+            "rate": rate,
+            "stage_seconds": {k: round(v, 4) for k, v in verifier.stage_seconds.items()},
+            "inflight_peak": pool.inflight_peak,
+            "trace_path": _dump_stage_trace("dev_chain"),
+        }
 
+    _enable_stage_trace()
     # timeouts soft-skip (budget guard); other errors propagate so the
     # caller's retry can fire on transient tunnel flakes
     try:
@@ -386,16 +392,44 @@ def bench_range_sync(time_budget_s: float = 240.0):
                 return None
         # replay through a fresh chain (same genesis) via the segment path
         consumer = DevChain(MINIMAL, cfg, 16, pool)
+        _enable_stage_trace()  # trace the replay only, not segment build
         t0 = _t.perf_counter()
         n = await consumer.chain.process_chain_segment(segment)
         dt = _t.perf_counter() - t0
         pool.close()
         assert n == len(segment), f"only {n}/{len(segment)} imported"
-        return n / dt
+        return {
+            "rate": n / dt,
+            "stage_seconds": {k: round(v, 4) for k, v in verifier.stage_seconds.items()},
+            "inflight_peak": pool.inflight_peak,
+            "trace_path": _dump_stage_trace("range_sync"),
+        }
 
     try:
         return asyncio.run(asyncio.wait_for(run(), time_budget_s * 2))
     except asyncio.TimeoutError:
+        return None
+
+
+def _enable_stage_trace() -> None:
+    """Span-trace the e2e stages (ISSUE 2): each emits a Chrome-trace
+    artifact whose path rides in the stage's extras."""
+    from lodestar_tpu import tracing
+
+    tracing.TRACER.clear()
+    tracing.enable(16384)
+
+
+def _dump_stage_trace(stage: str):
+    import tempfile
+
+    from lodestar_tpu import tracing
+
+    out_dir = os.environ.get("BENCH_TRACE_DIR", tempfile.gettempdir())
+    path = os.path.join(out_dir, f"lodestar_tpu_trace_{stage}.json")
+    try:
+        return tracing.write_chrome_trace(tracing.TRACER, path)
+    except OSError:
         return None
 
 
@@ -482,12 +516,16 @@ def main() -> None:
     small_dt, err = _stage("bench_small_bucket", (), 300)
     if err:
         errors["bucket16"] = err
-    chain_rate, err = _stage("bench_dev_chain", (), 420)
+    chain_res, err = _stage("bench_dev_chain", (), 420)
     if err:
         errors["dev_chain"] = err
-    range_rate, err = _stage("bench_range_sync", (), 600)
+    chain_res = chain_res or {}
+    chain_rate = chain_res.get("rate")
+    range_res, err = _stage("bench_range_sync", (), 600)
     if err:
         errors["range_sync"] = err
+    range_res = range_res or {}
+    range_rate = range_res.get("rate")
     scale, err = _stage("bench_scale_250k", (), 420)
     if err:
         errors["scale_250k"] = err
@@ -515,7 +553,13 @@ def main() -> None:
                     "cpu_oracle_sets_per_s": round(cpu_oracle, 3),
                     "baseline_kind": "fastbls-c" if cpu_native else "python-oracle",
                     "dev_chain_blocks_per_s": round(chain_rate, 3) if chain_rate else None,
+                    "dev_chain_stage_seconds": chain_res.get("stage_seconds"),
+                    "dev_chain_inflight_peak": chain_res.get("inflight_peak"),
+                    "dev_chain_trace": chain_res.get("trace_path"),
                     "range_sync_blocks_per_s": round(range_rate, 3) if range_rate else None,
+                    "range_sync_stage_seconds": range_res.get("stage_seconds"),
+                    "range_sync_inflight_peak": range_res.get("inflight_peak"),
+                    "range_sync_trace": range_res.get("trace_path"),
                     "scale_250k": scale,
                     "stage_errors": errors or None,
                     "backend": jax.default_backend(),

@@ -246,6 +246,10 @@ class RestApiServer:
         r("POST", "/eth/v1/validator/beacon_committee_subscriptions", self._committee_subs)
         r("POST", "/eth/v1/validator/sync_committee_subscriptions", self._sync_subs)
         r("GET", "/metrics", self._metrics)
+        # lodestar-namespace debug endpoints (routes/lodestar.ts analog):
+        # the hot-path span timeline and the BLS stage split
+        r("GET", "/eth/v1/lodestar/traces", self._traces)
+        r("GET", "/eth/v1/lodestar/bls_stages", self._bls_stages)
 
     # -- node/peers + config namespaces ----------------------------------------
 
@@ -980,3 +984,47 @@ class RestApiServer:
         if self.metrics_registry is None:
             raise ApiError(404, "metrics not enabled")
         return (self.metrics_registry.expose(), "text/plain; version=0.0.4")
+
+    def _traces(self, pp, q, b):
+        """Span-tracer dump (docs/observability.md).  Default: the raw
+        span list with correlation ids.  ``?format=chrome`` returns the
+        Chrome trace-event JSON that chrome://tracing / Perfetto load
+        directly — `curl .../traces?format=chrome > t.json` is the whole
+        capture workflow on a live node."""
+        from ..tracing import TRACER, to_chrome_trace
+
+        if q.get("format") == "chrome":
+            return (json.dumps(to_chrome_trace(TRACER)).encode(), "application/json")
+        spans = TRACER.spans()
+        return {
+            "data": {
+                "enabled": TRACER.enabled,
+                "capacity": TRACER.capacity,
+                "dropped": TRACER.dropped,
+                "count": len(spans),
+                "spans": [s.to_dict() for s in spans],
+            }
+        }
+
+    def _bls_stages(self, pp, q, b):
+        """The previously-unexported BLS pipeline counters: the verifier's
+        cumulative per-stage seconds and the pool's pipelining stats."""
+        pool = getattr(self.chain, "bls", None) if self.chain is not None else None
+        if pool is None:
+            raise ApiError(404, "bls pool not available")
+        verifier = getattr(pool, "verifier", None)
+        data = {
+            "stage_seconds": dict(getattr(verifier, "stage_seconds", None) or {}),
+            "inflight_peak": getattr(pool, "inflight_peak", 0),
+            "pipeline_depth": getattr(pool, "pipeline_depth", 1),
+            "batch_retries": getattr(pool, "batch_retries", 0),
+            "batch_sets_success": getattr(pool, "batch_sets_success", 0),
+            "pending_sets": pool.pending_sets() if hasattr(pool, "pending_sets") else 0,
+            "verifier": type(verifier).__name__ if verifier is not None else None,
+            "dispatches": getattr(verifier, "dispatches", 0),
+            "sets_verified": getattr(verifier, "sets_verified", 0),
+            "padding_wasted": getattr(verifier, "padding_wasted", 0),
+            "host_final_exps": getattr(verifier, "host_final_exps", 0),
+            "fused_fallbacks": getattr(verifier, "fused_fallbacks", 0),
+        }
+        return {"data": data}

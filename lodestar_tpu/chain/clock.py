@@ -9,6 +9,8 @@ import asyncio
 import time
 from typing import Callable, Optional
 
+from ..tracing import TRACER
+
 
 class LocalClock:
     """Slot/epoch ticker.  ``now_fn`` is injectable so tests and the dev
@@ -47,10 +49,18 @@ class LocalClock:
         hi = self.slot_start_time(slot + 1) + disparity_sec
         return lo <= self.now_fn() <= hi
 
+    def annotate_slot(self, slot: int) -> None:
+        """Drop a slot-boundary marker on the trace timeline so BLS spans
+        can be read against slot/epoch edges."""
+        if TRACER.enabled:
+            TRACER.instant("clock.slot", cat="clock", slot=slot,
+                           epoch=slot // self.slots_per_epoch)
+
     async def wait_for_slot(self, slot: int) -> None:
         delta = self.slot_start_time(slot) - self.now_fn()
         if delta > 0:
             await asyncio.sleep(delta)
+        self.annotate_slot(slot)
 
 
 class ManualClock(LocalClock):
@@ -63,6 +73,8 @@ class ManualClock(LocalClock):
 
     def set_slot(self, slot: int, seconds_into: float = 0.0) -> None:
         self._now = self.genesis_time + slot * self.seconds_per_slot + seconds_into
+        if seconds_into == 0.0:
+            self.annotate_slot(slot)
 
     async def wait_for_slot(self, slot: int) -> None:
         self.set_slot(slot)

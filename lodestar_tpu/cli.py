@@ -117,6 +117,23 @@ def build_parser() -> argparse.ArgumentParser:
             help="persistent XLA compilation cache directory "
             "(default: $LODESTAR_TPU_JAX_CACHE or repo-local .jax_cache)",
         )
+        p.add_argument(
+            "--trace-dump", default=None, metavar="PATH",
+            help="enable hot-path span tracing and write a Chrome trace-"
+            "event JSON (open in Perfetto / chrome://tracing) to PATH on "
+            "shutdown (docs/observability.md)",
+        )
+        p.add_argument(
+            "--trace-buffer-size", type=int, default=8192,
+            help="span ring-buffer capacity when tracing is enabled "
+            "(old spans are evicted, never accumulated)",
+        )
+        p.add_argument(
+            "--jax-profile", default=None, metavar="DIR",
+            help="run jax.profiler around the (blocking) BLS warmup and "
+            "write the device profile to DIR — the XLA-level view the "
+            "span tracer sits above",
+        )
 
     dev = sub.add_parser("dev", help="single-process interop chain (cmds/dev)")
     common(dev)
@@ -207,6 +224,7 @@ async def run_dev(args) -> int:
 
     preset = _preset(args.preset)
     cfg = _chain_config(args)
+    _configure_tracing(args)
     # full Metrics group (not just the registry) so the pool/verifier
     # observe the new pipeline-stage histograms in dev mode too
     metrics = create_metrics() if args.metrics else None
@@ -243,6 +261,30 @@ async def run_dev(args) -> int:
     await rest.close()
     pool.close()
     return 0
+
+
+def _configure_tracing(args) -> None:
+    """Enable the span tracer when --trace-dump asks for it.  Called
+    before the pool is built so warmup and the first dispatches land in
+    the buffer; the dump itself happens in main()'s finally so Ctrl-C on
+    a forever-running node still writes the file."""
+    dump = getattr(args, "trace_dump", None)
+    if dump:
+        from . import tracing
+
+        tracing.enable(getattr(args, "trace_buffer_size", 8192))
+        logger.info("span tracing on (buffer %d); dump -> %s",
+                    tracing.TRACER.capacity, dump)
+
+
+def _dump_trace(path) -> None:
+    if not path:
+        return
+    from . import tracing
+
+    tracing.write_chrome_trace(tracing.TRACER, path)
+    logger.info("wrote %d spans (%d dropped) to %s",
+                len(tracing.TRACER), tracing.TRACER.dropped, path)
 
 
 def _make_pool(args, metrics=None):
@@ -284,7 +326,20 @@ def _make_verifier(args):
         fused = None if fused_flag == "auto" else fused_flag == "on"
         v = TpuBlsVerifier(buckets=buckets, fused=fused)
         warm = getattr(args, "bls_warmup", "background")
-        if warm == "blocking":
+        profile_dir = getattr(args, "jax_profile", None)
+        if profile_dir and warm != "off":
+            # device-level profile of the AOT compiles + first dispatches;
+            # forces blocking warmup so stop_trace() brackets real work
+            import jax
+
+            jax.profiler.start_trace(profile_dir)
+            try:
+                dt = v.warmup()
+            finally:
+                jax.profiler.stop_trace()
+            logger.info("bls AOT warmup under jax.profiler: %d buckets in "
+                        "%.1fs -> %s", len(buckets), dt, profile_dir)
+        elif warm == "blocking":
             dt = v.warmup()
             logger.info("bls AOT warmup: %d buckets in %.1fs", len(buckets), dt)
         elif warm == "background":
@@ -321,6 +376,7 @@ async def run_beacon(args) -> int:
 
     preset = _preset(args.preset)
     cfg = _chain_config(args)
+    _configure_tracing(args)
     controller = SqliteDbController(args.db) if args.db else MemoryDbController()
     db = BeaconDb(preset, controller)
     anchor_block_root = None
@@ -678,9 +734,17 @@ def main(argv: Optional[list] = None) -> int:
         print(f"bad --config file: {e}", file=sys.stderr)
         return 2
     if args.cmd == "dev":
-        return asyncio.run(run_dev(args))
+        try:
+            return asyncio.run(run_dev(args))
+        finally:
+            # synchronous write in the finally: a Ctrl-C on a forever
+            # node (--slots 0) must still produce the trace artifact
+            _dump_trace(getattr(args, "trace_dump", None))
     if args.cmd == "beacon":
-        return asyncio.run(run_beacon(args))
+        try:
+            return asyncio.run(run_beacon(args))
+        finally:
+            _dump_trace(getattr(args, "trace_dump", None))
     if args.cmd == "validator":
         return asyncio.run(run_validator(args))
     if args.cmd == "lightclient":

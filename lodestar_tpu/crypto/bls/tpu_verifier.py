@@ -47,6 +47,7 @@ import numpy as np
 from ...ops import batch_verify as bv
 from ...ops import htc
 from ...ops import limbs as fl
+from ...tracing import TRACER, current_batch_id
 from ...utils.logger import get_logger
 from .curve import g2_from_bytes
 from .verifier import SignatureSet, get_aggregated_pubkey
@@ -134,7 +135,15 @@ class PendingVerdict:
             elif self._f is not None:
                 self._value = self._verifier._host_final_exp_verdict(self._f, self._ok)
             else:
-                self._value = bool(self._out)  # fused on-device verdict
+                # fused on-device verdict: the bool() read is the sync; the
+                # span plays the final_exp role on this path's timeline
+                t0_ns = TRACER.now()
+                self._value = bool(self._out)
+                if TRACER.enabled:
+                    TRACER.add_span(
+                        "bls.final_exp", "bls", t0_ns,
+                        cid=current_batch_id(), on_device=True,
+                    )
         return self._value
 
 
@@ -288,6 +297,8 @@ class TpuBlsVerifier:
                     return self.warmup(buckets) + (time.perf_counter() - t0)
         dt = time.perf_counter() - t0
         self.stage_seconds["warmup"] += dt
+        if TRACER.enabled:
+            TRACER.instant("bls.warmup_done", cat="bls", seconds=round(dt, 3))
         return dt
 
     def warmup_async(self, buckets: Optional[Sequence[int]] = None) -> threading.Thread:
@@ -304,6 +315,7 @@ class TpuBlsVerifier:
         bigint oracle as fallback).  The ``bool(ok)`` read is the device
         sync point, so this stage's timing covers readback + final exp."""
         t0 = time.perf_counter()
+        t0_ns = TRACER.now()
         try:
             if not bool(ok):
                 return False
@@ -333,6 +345,9 @@ class TpuBlsVerifier:
             self.stage_seconds["final_exp"] += dt
             if self.metrics:
                 self.metrics.bls_pool_final_exp_seconds.observe(dt)
+            if TRACER.enabled:
+                TRACER.add_span("bls.final_exp", "bls", t0_ns,
+                                cid=current_batch_id())
 
     def _bucket(self, n: int) -> int:
         for b in self.buckets:
@@ -378,6 +393,7 @@ class TpuBlsVerifier:
         self.dispatches += 1
         self.sets_verified += int(np.sum(np.asarray(packed[6])))
         n = packed[0].shape[0]
+        t0_ns = TRACER.now()
         # snapshot the path THIS call uses: a concurrent warmup_async thread
         # may degrade self.fused mid-flight, and the except arm must judge
         # the path that actually raised, not the flag's latest value
@@ -391,6 +407,11 @@ class TpuBlsVerifier:
             self.fused = False
             self.fused_fallbacks += 1
             out = self._fn(n, fused=False)(*packed)
+        if TRACER.enabled:
+            # covers the async enqueue only (plus compile when cold); the
+            # device compute itself surfaces as the gap before final_exp
+            TRACER.add_span("bls.dispatch", "bls", t0_ns,
+                            cid=current_batch_id(), bucket=n, fused=used_fused)
         if self.host_final_exp:
             f, ok = out
             return PendingVerdict(verifier=self, f=f, ok=ok)
@@ -408,6 +429,7 @@ class TpuBlsVerifier:
         loops.  Returns the 7-tuple of device-ready arrays, or None when
         any set is malformed (infinity pubkey/signature, bad bytes)."""
         t0 = time.perf_counter()
+        t0_ns = TRACER.now()
         try:
             n = len(sets)
             b = self._bucket(n)
@@ -466,6 +488,9 @@ class TpuBlsVerifier:
             self.stage_seconds["pack"] += dt
             if self.metrics:
                 self.metrics.bls_pool_pack_seconds.observe(dt)
+            if TRACER.enabled:
+                TRACER.add_span("bls.pack", "bls", t0_ns,
+                                cid=current_batch_id(), sets=len(sets))
 
     # kept for callers/tests that used the private name
     _pack = pack

@@ -124,6 +124,27 @@ class Metrics:
             "lodestar_bls_pool_inflight_depth",
             "merged batches concurrently in flight on the device pipeline",
         )
+        # span-derived pipeline observability (docs/observability.md)
+        self.bls_pool_queue_wait_seconds = r.histogram(
+            "lodestar_bls_pool_queue_wait_seconds",
+            "time a job sat in the pool buffer before its batch was drained",
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.02, 0.05, 0.1, 0.5, 1),
+        )
+        self.bls_pool_overlap_ratio = r.gauge(
+            "lodestar_bls_pool_overlap_ratio",
+            "sum of in-flight batch busy time / flush wall time "
+            "(>1 means batches overlapped; 1 is fully serial)",
+        )
+        self.bls_pool_inflight_peak = r.gauge(
+            "lodestar_bls_pool_inflight_peak",
+            "highest in-flight depth the pipeline has reached",
+        )
+        self.bls_verifier_stage_seconds = r.gauge(
+            "lodestar_bls_verifier_stage_seconds",
+            "cumulative wall seconds the verifier spent per stage "
+            "(TpuBlsVerifier.stage_seconds snapshot, updated on flush)",
+            labels=("stage",),
+        )
         # chain
         self.block_processing_seconds = r.histogram(
             "lodestar_block_processing_seconds",

@@ -96,19 +96,25 @@ class JobItemQueue(Generic[T, R]):
         self._schedule()
         return await fut
 
-    def drain_batch(self, max_items: int) -> List[Tuple[T, "asyncio.Future[R]"]]:
+    def drain_batch(
+        self, max_items: int, with_enqueue_time: bool = False
+    ) -> List[Tuple]:
         """Pull up to max_items pending jobs for external batch processing.
 
         The caller becomes responsible for resolving the futures. This is the
-        TPU batch-accumulation seam.
+        TPU batch-accumulation seam.  ``with_enqueue_time=True`` returns
+        (item, fut, t_enqueue) triples — t_enqueue is the ``time.monotonic()``
+        of the push, so the consumer can derive per-job queue-wait spans and
+        histograms (chain/bls_pool feeds lodestar_bls_pool_queue_wait_seconds
+        and the ``bls.queue_wait`` trace spans from it).
         """
-        out: List[Tuple[T, "asyncio.Future[R]"]] = []
+        out: List[Tuple] = []
         while self._items and len(out) < max_items:
             item, fut, t0 = self._pop()
             if fut.done():  # pusher was cancelled; nothing to resolve
                 continue
             self.metrics.job_wait_seconds_sum += time.monotonic() - t0
-            out.append((item, fut))
+            out.append((item, fut, t0) if with_enqueue_time else (item, fut))
         self.metrics.length = len(self._items)
         return out
 

@@ -71,6 +71,51 @@ def test_metric_families_exposed_and_monitor_depth():
     asyncio.run(main())
 
 
+def test_metrics_endpoint_exposition():
+    """/metrics surface (ISSUE 2 satellite 3): 404 without a registry,
+    the prometheus text content type with one, and the PR-1 pool metric
+    names present in the exposition."""
+    from lodestar_tpu.api.rest import RestApiServer
+
+    async def main():
+        # no registry wired -> 404 (metrics not enabled)
+        bare = RestApiServer(MINIMAL, chain=None)
+        status, payload, ctype = await bare._dispatch("GET", "/metrics", b"")
+        assert status == 404 and ctype == "application/json"
+
+        metrics = create_metrics()
+        # drive the pool-side families so they carry samples, not just help
+        pool = BlsBatchPool(FastBlsVerifier(), max_buffer_wait=0.005, metrics=metrics)
+        from lodestar_tpu.crypto.bls.api import interop_secret_key
+        from lodestar_tpu.crypto.bls.verifier import SingleSignatureSet
+
+        sk = interop_secret_key(0)
+        one = SingleSignatureSet(
+            pubkey=sk.to_public_key(),
+            signing_root=b"\x07" * 32,
+            signature=sk.sign(b"\x07" * 32).to_bytes(),
+        )
+        assert await pool.verify_signature_sets([one])
+        pool.close()
+
+        server = RestApiServer(MINIMAL, chain=None, metrics_registry=metrics.reg)
+        status, payload, ctype = await server._dispatch("GET", "/metrics", b"")
+        assert status == 200
+        assert ctype == "text/plain; version=0.0.4"
+        text = payload.decode()
+        for family in (
+            "lodestar_bls_pool_pack_seconds",
+            "lodestar_bls_pool_inflight_depth",
+            "lodestar_bls_pool_queue_wait_seconds",
+            "lodestar_bls_pool_overlap_ratio",
+            "lodestar_bls_verifier_stage_seconds",
+        ):
+            assert family in text, f"missing from exposition: {family}"
+        assert "lodestar_bls_pool_queue_wait_seconds_count 1.0" in text
+
+    asyncio.run(main())
+
+
 def test_gossip_router_metrics():
     """Mesh gauge + validation verdict counters feed from the router."""
     from lodestar_tpu.network.gossip import GossipRouter

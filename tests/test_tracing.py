@@ -1,0 +1,421 @@
+"""Hot-path span tracing (ISSUE 2): tracer semantics, Chrome export,
+batch-correlated pipeline spans through the BLS pool, debug endpoints,
+and the two standalone observability gates under tools/.
+
+Deliberately trace-light: no jax.jit compiles — the real pack()
+instrumentation is exercised host-side, and the dispatch/final-exp spans
+through a stage-split fake verifier (the TpuBlsVerifier timing shape
+without a device).
+"""
+
+import asyncio
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from lodestar_tpu import tracing
+from lodestar_tpu.chain.bls_pool import BlsBatchPool
+from lodestar_tpu.crypto.bls.api import interop_secret_key
+from lodestar_tpu.crypto.bls.verifier import SingleSignatureSet
+from lodestar_tpu.metrics import create_metrics
+from lodestar_tpu.tracing import TRACER, SpanTracer, to_chrome_trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+check_trace = _load_tool("check_trace")
+check_metrics_coverage = _load_tool("check_metrics_coverage")
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """The module singleton must not leak state across tests (or into the
+    rest of the suite)."""
+    TRACER.disable()
+    TRACER.clear()
+    yield
+    TRACER.disable()
+    TRACER.clear()
+
+
+def make_set(i, valid=True):
+    sk = interop_secret_key(i)
+    msg = bytes([i % 256]) * 32
+    signer = sk if valid else interop_secret_key(i + 100)
+    return SingleSignatureSet(
+        pubkey=sk.to_public_key(),
+        signing_root=msg,
+        signature=signer.sign(msg).to_bytes(),
+    )
+
+
+class TestTracer:
+    def test_disabled_records_nothing(self):
+        tr = SpanTracer(capacity=8)
+        tr.add_span("a", "x", 0, 10)
+        tr.instant("b")
+        with tr.span("c", "x"):
+            pass
+        assert len(tr) == 0
+        assert tr.now() == 0  # disabled path never calls the clock
+
+    def test_ring_buffer_evicts_oldest(self):
+        tr = SpanTracer(capacity=4)
+        tr.enable()
+        for i in range(10):
+            tr.add_span(f"s{i}", "x", i, i + 1)
+        spans = tr.spans()
+        assert len(spans) == 4
+        assert [s.name for s in spans] == ["s6", "s7", "s8", "s9"]
+        assert tr.dropped == 6
+
+    def test_enable_resizes_and_span_fields(self):
+        tr = SpanTracer(capacity=4)
+        tr.enable(capacity=128)
+        assert tr.capacity == 128
+        t0 = time.monotonic_ns()
+        with tr.span("work", "cat", cid=7, n=3):
+            pass
+        tr.instant("mark", slot=5)
+        work, mark = tr.spans()
+        assert work.name == "work" and work.cid == 7 and work.args == {"n": 3}
+        assert work.ts_ns >= t0 and work.dur_ns >= 0
+        assert work.tid == threading.get_ident()
+        assert mark.instant and mark.args == {"slot": 5}
+
+    def test_thread_safety_concurrent_writers(self):
+        tr = SpanTracer(capacity=64)
+        tr.enable()
+
+        def write(k):
+            for i in range(50):
+                tr.add_span(f"t{k}", "x", tr.now())
+
+        threads = [threading.Thread(target=write, args=(k,)) for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(tr) == 64
+        assert tr.dropped == 4 * 50 - 64
+
+
+class TestChromeExport:
+    def test_export_schema_validates(self, tmp_path):
+        tr = SpanTracer()
+        tr.enable()
+        with tr.span("bls.pack", "bls", cid=1, sets=4):
+            pass
+        tr.instant("clock.slot", cat="clock", slot=3)
+        doc = to_chrome_trace(tr)
+        assert check_trace.validate(doc) == []
+        names = [e["name"] for e in doc["traceEvents"]]
+        assert "process_name" in names and "thread_name" in names
+        pack = next(e for e in doc["traceEvents"] if e["name"] == "bls.pack")
+        assert pack["ph"] == "X" and pack["args"]["cid"] == 1 and pack["id"] == 1
+        inst = next(e for e in doc["traceEvents"] if e["name"] == "clock.slot")
+        assert inst["ph"] == "i"
+        # the CLI entry accepts the dump too
+        path = tmp_path / "trace.json"
+        tracing.write_chrome_trace(tr, str(path))
+        assert check_trace.main([str(path)]) == 0
+
+    def test_validator_rejects_malformed(self):
+        assert check_trace.validate(42)
+        assert check_trace.validate({"nope": []})
+        errs = check_trace.validate(
+            {"traceEvents": [{"ph": "X", "name": "a", "ts": 1},  # no dur
+                             {"name": "b"},  # no ph
+                             {"ph": "i", "name": "c", "ts": 0, "pid": "x"}]}
+        )
+        assert len(errs) == 3
+
+    def test_pipeline_requirement(self):
+        tr = SpanTracer()
+        tr.enable()
+        for cid in (1, 2):
+            for name in check_trace.PIPELINE_SPANS:
+                tr.add_span(name, "bls", 0, 1000, cid=cid)
+        doc = to_chrome_trace(tr)
+        assert check_trace.validate_pipeline(doc, 2) == []
+        assert check_trace.validate_pipeline(doc, 3)  # only 2 batches
+        # zero-duration spans don't count
+        tr2 = SpanTracer()
+        tr2.enable()
+        for name in check_trace.PIPELINE_SPANS:
+            tr2.add_span(name, "bls", 5, 5, cid=1)
+        assert check_trace.validate_pipeline(to_chrome_trace(tr2), 1)
+
+
+class StageTracedVerifier:
+    """Stage-split fake with the TpuBlsVerifier timing shape AND its span
+    emissions: pack blocks the calling thread, the 'device' computes in
+    wall time after the async enqueue, result() syncs then pays the host
+    final-exp cost.  Spans are stamped with the pool-assigned correlation
+    id read from the contextvar — proving the id propagates through
+    asyncio.to_thread into both halves of the flusher."""
+
+    PACK_S = 0.02
+    DEVICE_S = 0.04
+    FINAL_S = 0.02
+
+    def __init__(self):
+        self.dispatched = 0
+        self.stage_seconds = {"pack": 0.0, "dispatch": 0.0, "final_exp": 0.0}
+
+    def verify_signature_sets_async(self, sets):
+        cid = tracing.current_batch_id()
+        t0 = TRACER.now()
+        time.sleep(self.PACK_S)
+        TRACER.add_span("bls.pack", "bls", t0, cid=cid, sets=len(sets))
+        self.stage_seconds["pack"] += self.PACK_S
+        t0 = TRACER.now()
+        self.dispatched += 1
+        ready_at = time.monotonic() + self.DEVICE_S
+        TRACER.add_span("bls.dispatch", "bls", t0, cid=cid, bucket=len(sets))
+        self.stage_seconds["dispatch"] += 1e-4
+        outer = self
+
+        class _Pending:
+            def result(_self):
+                rem = ready_at - time.monotonic()
+                if rem > 0:
+                    time.sleep(rem)  # device sync
+                t0 = TRACER.now()
+                time.sleep(outer.FINAL_S)
+                TRACER.add_span(
+                    "bls.final_exp", "bls", t0, cid=tracing.current_batch_id()
+                )
+                outer.stage_seconds["final_exp"] += outer.FINAL_S
+                return True
+
+        return _Pending()
+
+    def verify_signature_sets(self, sets):
+        return self.verify_signature_sets_async(sets).result()
+
+
+class TestPoolPipelineSpans:
+    def test_correlated_pipeline_spans_two_inflight_batches(self, tmp_path):
+        """Acceptance: >=2 in-flight batches leave queue-wait / pack /
+        dispatch / final-exp spans with non-zero durations under >=2
+        distinct correlation ids, and the dump passes
+        tools/check_trace.py --require-pipeline."""
+
+        async def main():
+            tracing.enable(1024)
+            v = StageTracedVerifier()
+            metrics = create_metrics()
+            pool = BlsBatchPool(
+                v, max_buffer_wait=0.004, pipeline_depth=3, metrics=metrics
+            )
+            # stagger pushes so the flusher drains three separate batches,
+            # each landing while the previous batch is still packing
+            jobs = [asyncio.create_task(pool.verify_signature_sets([make_set(0)]))]
+            for i in (1, 2):
+                await asyncio.sleep(StageTracedVerifier.PACK_S * 0.9)
+                jobs.append(
+                    asyncio.create_task(pool.verify_signature_sets([make_set(i)]))
+                )
+            assert await asyncio.gather(*jobs) == [True] * 3
+            assert pool.inflight_peak >= 2
+            pool.close()
+            return pool, metrics
+
+        pool, metrics = asyncio.run(main())
+
+        spans = TRACER.spans()
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s.name, []).append(s)
+        for name in ("bls.queue_wait", "bls.pack", "bls.dispatch",
+                     "bls.final_exp", "pool.batch"):
+            assert by_name.get(name), f"missing {name} spans: {sorted(by_name)}"
+        cids = {s.cid for s in by_name["bls.pack"]}
+        assert len(cids) >= 2, cids
+        # every batch's stages share its correlation id
+        for cid in cids:
+            stages = {s.name for s in spans if s.cid == cid}
+            assert {"bls.queue_wait", "bls.pack", "bls.dispatch",
+                    "bls.final_exp", "pool.batch"} <= stages, (cid, stages)
+        assert all(s.dur_ns > 0 for s in by_name["bls.pack"])
+
+        path = str(tmp_path / "pipeline.json")
+        tracing.write_chrome_trace(TRACER, path)
+        assert check_trace.main([path, "--require-pipeline", "2"]) == 0
+
+        # satellite 1: the orphaned counters are now gauges, set on flush
+        text = metrics.reg.expose().decode()
+        assert 'lodestar_bls_verifier_stage_seconds{stage="pack"}' in text
+        assert "lodestar_bls_pool_inflight_peak" in text
+        assert "lodestar_bls_pool_overlap_ratio" in text
+        assert "lodestar_bls_pool_queue_wait_seconds_count" in text
+        try:
+            assert metrics.bls_pool_inflight_peak._value.get() >= 2
+            assert metrics.bls_pool_overlap_ratio._value.get() > 1.0  # pipelined
+        except AttributeError:  # prometheus absent -> noop metrics
+            pass
+
+    def test_disabled_tracer_records_nothing_on_hot_path(self):
+        async def main():
+            pool = BlsBatchPool(StageTracedVerifier(), max_buffer_wait=0.002)
+            jobs = [pool.verify_signature_sets([make_set(i)]) for i in range(3)]
+            assert await asyncio.gather(*jobs) == [True] * 3
+            pool.close()
+
+        asyncio.run(main())
+        assert len(TRACER) == 0
+
+    def test_real_pack_emits_span(self):
+        """The real TpuBlsVerifier.pack instrumentation (host-only, no
+        jit: packing is numpy + bigint + sha256)."""
+        from lodestar_tpu.crypto.bls.tpu_verifier import TpuBlsVerifier
+
+        tracing.enable(64)
+        v = TpuBlsVerifier(platform="cpu")
+        packed = v.pack([make_set(0), make_set(1)])
+        assert packed is not None
+        spans = [s for s in TRACER.spans() if s.name == "bls.pack"]
+        assert len(spans) == 1
+        assert spans[0].dur_ns > 0 and spans[0].args == {"sets": 2}
+        assert spans[0].cid is None  # no pool context here
+
+    def test_clock_slot_annotations(self):
+        from lodestar_tpu.chain.clock import ManualClock
+
+        tracing.enable(64)
+        clock = ManualClock(0, 6, 8)
+        clock.set_slot(9)
+        marks = [s for s in TRACER.spans() if s.name == "clock.slot"]
+        assert marks and marks[-1].args == {"slot": 9, "epoch": 1}
+
+    def test_queue_drain_with_enqueue_time(self):
+        from lodestar_tpu.utils.queue import JobItemQueue
+
+        async def main():
+            async def process(x):
+                return x
+
+            q = JobItemQueue(process, max_length=10, max_concurrency=0)
+            tasks = [asyncio.create_task(q.push(i)) for i in range(2)]
+            await asyncio.sleep(0)
+            t_before = time.monotonic()
+            batch = q.drain_batch(5, with_enqueue_time=True)
+            assert [row[0] for row in batch] == [0, 1]
+            assert all(len(row) == 3 for row in batch)
+            for item, fut, t_enq in batch:
+                assert t_enq <= t_before
+                fut.set_result(item)
+            assert await asyncio.gather(*tasks) == [0, 1]
+
+        asyncio.run(main())
+
+
+class TestDebugEndpoints:
+    def _server(self, with_pool=True, with_registry=False):
+        from lodestar_tpu.api.rest import RestApiServer
+        from lodestar_tpu.params import MINIMAL
+
+        class _StubChain:
+            bls = None
+
+        chain = _StubChain()
+        metrics = create_metrics() if with_registry else None
+        if with_pool:
+            chain.bls = BlsBatchPool(StageTracedVerifier(), metrics=metrics)
+        return RestApiServer(
+            MINIMAL, chain, metrics_registry=metrics.reg if metrics else None,
+            metrics=metrics,
+        ), chain
+
+    def test_traces_endpoint_json_and_chrome(self):
+        tracing.enable(64)
+        TRACER.add_span("bls.pack", "bls", 100, 2100, cid=5, sets=1)
+        server, _ = self._server(with_pool=False)
+
+        async def main():
+            status, payload, ctype = await server._dispatch(
+                "GET", "/eth/v1/lodestar/traces", b""
+            )
+            assert status == 200 and ctype == "application/json"
+            assert payload["data"]["enabled"] is True
+            assert payload["data"]["count"] == 1
+            span = payload["data"]["spans"][0]
+            assert span["name"] == "bls.pack" and span["cid"] == 5
+            assert span["dur_us"] == 2.0
+
+            status, raw, ctype = await server._dispatch(
+                "GET", "/eth/v1/lodestar/traces?format=chrome", b""
+            )
+            assert status == 200
+            doc = json.loads(raw.decode())
+            assert check_trace.validate(doc) == []
+
+        asyncio.run(main())
+
+    def test_bls_stages_endpoint(self):
+        server, chain = self._server(with_pool=True)
+        chain.bls.verifier.stage_seconds["pack"] = 1.25
+        chain.bls.inflight_peak = 3
+
+        async def main():
+            status, payload, _ = await server._dispatch(
+                "GET", "/eth/v1/lodestar/bls_stages", b""
+            )
+            assert status == 200
+            data = payload["data"]
+            assert data["stage_seconds"]["pack"] == 1.25
+            assert data["inflight_peak"] == 3
+            assert data["verifier"] == "StageTracedVerifier"
+            chain.bls.close()
+
+        asyncio.run(main())
+
+    def test_bls_stages_404_without_pool(self):
+        server, _ = self._server(with_pool=False)
+
+        async def main():
+            status, payload, _ = await server._dispatch(
+                "GET", "/eth/v1/lodestar/bls_stages", b""
+            )
+            assert status == 404
+
+        asyncio.run(main())
+
+
+class TestMetricsCoverageGate:
+    def test_registry_metrics_all_covered(self):
+        """CI gate: every metric in registry.py appears in a dashboard or
+        a doc (tools/check_metrics_coverage.py, runnable standalone)."""
+        report = check_metrics_coverage.check(REPO)
+        assert len(report) >= 50  # the registry is substantial
+        orphans = [
+            m for m, cov in report.items()
+            if not cov["dashboards"] and not cov["docs"]
+        ]
+        assert orphans == [], f"orphan metrics (add a panel or doc row): {orphans}"
+        assert check_metrics_coverage.main(["--repo", REPO]) == 0
+
+    def test_gate_catches_orphan(self, tmp_path):
+        """The tool actually fails when a metric is unreferenced."""
+        repo = tmp_path
+        (repo / "lodestar_tpu" / "metrics").mkdir(parents=True)
+        (repo / "lodestar_tpu" / "metrics" / "registry.py").write_text(
+            's = r.gauge(\n    "lodestar_ghost_metric", "never shown anywhere"\n)\n'
+        )
+        (repo / "docs").mkdir()
+        (repo / "docs" / "observability.md").write_text("# nothing here\n")
+        assert check_metrics_coverage.main(["--repo", str(repo)]) == 1

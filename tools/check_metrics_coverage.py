@@ -1,0 +1,86 @@
+#!/usr/bin/env python3
+"""Fail when a metric registered in metrics/registry.py is invisible —
+i.e. appears in no Grafana dashboard under dashboards/ and in no doc
+under docs/.
+
+A metric nobody can see is dead weight on the exposition AND a broken
+promise to the operator; this gate forces every new registry entry to
+land with either a dashboard panel or a docs/observability.md table row
+(usually both).  Runnable standalone and from tests/test_tracing.py.
+
+Usage:
+    python tools/check_metrics_coverage.py [--repo PATH] [--list]
+
+Exit 0 when every metric is covered; exit 1 listing the orphans.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+from typing import Dict, List
+
+# r.counter("name", ...) / r.gauge(...) / r.histogram(...) in registry.py;
+# \s* spans the newline argparse-style call wrapping produces
+_METRIC_RE = re.compile(r"r\.(?:counter|gauge|histogram)\(\s*\"([^\"]+)\"")
+
+
+def registered_metrics(repo: str) -> List[str]:
+    path = os.path.join(repo, "lodestar_tpu", "metrics", "registry.py")
+    with open(path) as f:
+        return _METRIC_RE.findall(f.read())
+
+
+def _corpus(repo: str, subdir: str, exts: tuple) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    root = os.path.join(repo, subdir)
+    if not os.path.isdir(root):
+        return out
+    for name in sorted(os.listdir(root)):
+        if name.endswith(exts):
+            with open(os.path.join(root, name)) as f:
+                out[os.path.join(subdir, name)] = f.read()
+    return out
+
+
+def check(repo: str) -> Dict[str, Dict[str, List[str]]]:
+    """Per-metric coverage: which dashboards and docs mention it."""
+    dashboards = _corpus(repo, "dashboards", (".json",))
+    docs = _corpus(repo, "docs", (".md",))
+    report: Dict[str, Dict[str, List[str]]] = {}
+    for metric in registered_metrics(repo):
+        report[metric] = {
+            "dashboards": [p for p, text in dashboards.items() if metric in text],
+            "docs": [p for p, text in docs.items() if metric in text],
+        }
+    return report
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--repo", default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    ap.add_argument("--list", action="store_true", help="print full coverage table")
+    args = ap.parse_args(argv)
+    report = check(args.repo)
+    if not report:
+        print("no metrics found in registry.py", file=sys.stderr)
+        return 1
+    orphans = [m for m, cov in report.items() if not cov["dashboards"] and not cov["docs"]]
+    if args.list:
+        for metric, cov in sorted(report.items()):
+            mark = "ORPHAN" if metric in orphans else "ok"
+            print(f"{mark:7s} {metric}  dashboards={len(cov['dashboards'])} docs={len(cov['docs'])}")
+    for metric in orphans:
+        print(
+            f"orphan metric: {metric} appears in no dashboards/*.json and no docs/*.md",
+            file=sys.stderr,
+        )
+    if not orphans:
+        print(f"metrics coverage OK: {len(report)} metrics all referenced")
+    return 1 if orphans else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,136 @@
+#!/usr/bin/env python3
+"""Validate a trace dump against the Chrome trace-event schema.
+
+Usage:
+    python tools/check_trace.py TRACE.json [--require-pipeline [N]]
+
+Checks (the subset of the Trace Event Format spec that chrome://tracing
+and Perfetto actually require to load a file):
+
+- top level is an object with a ``traceEvents`` list (or a bare list);
+- every event is an object with a string ``name`` and a string ``ph``;
+- ``X``/``B``/``E``/``i``/``I`` events carry a numeric ``ts``;
+- complete events (``ph == "X"``) carry a numeric non-negative ``dur``;
+- ``pid``/``tid``, when present, are integers;
+- ``args``, when present, is an object.
+
+``--require-pipeline [N]`` additionally asserts the dump contains the
+full BLS span taxonomy — ``bls.queue_wait`` / ``bls.pack`` /
+``bls.dispatch`` / ``bls.final_exp`` — with non-zero durations, batch-
+correlated (same ``args.cid``) for at least N distinct merged batches
+(default 2).  This is the acceptance gate for a ``--trace-dump`` dev-chain
+run; tests/test_tracing.py drives it in-process.
+
+Exit 0 on success; exit 1 with one error per line on failure.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Dict, List
+
+PIPELINE_SPANS = ("bls.queue_wait", "bls.pack", "bls.dispatch", "bls.final_exp")
+_TS_PHASES = {"X", "B", "E", "i", "I"}
+
+
+def validate(trace: Any) -> List[str]:
+    """Schema errors for a parsed trace object (empty list = valid)."""
+    errors: List[str] = []
+    if isinstance(trace, dict):
+        events = trace.get("traceEvents")
+        if not isinstance(events, list):
+            return ["top-level object has no traceEvents list"]
+    elif isinstance(trace, list):
+        events = trace
+    else:
+        return [f"trace must be an object or array, got {type(trace).__name__}"]
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: event is not an object")
+            continue
+        if not isinstance(ev.get("name"), str):
+            errors.append(f"{where}: missing string 'name'")
+        ph = ev.get("ph")
+        if not isinstance(ph, str) or not ph:
+            errors.append(f"{where}: missing string 'ph'")
+            continue
+        if ph in _TS_PHASES and not isinstance(ev.get("ts"), (int, float)):
+            errors.append(f"{where} ({ev.get('name')}): ph={ph} requires numeric 'ts'")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                errors.append(
+                    f"{where} ({ev.get('name')}): complete event requires "
+                    f"non-negative numeric 'dur'"
+                )
+        for key in ("pid", "tid"):
+            if key in ev and not isinstance(ev[key], int):
+                errors.append(f"{where}: '{key}' must be an integer")
+        if "args" in ev and not isinstance(ev["args"], dict):
+            errors.append(f"{where}: 'args' must be an object")
+    return errors
+
+
+def validate_pipeline(trace: Any, min_batches: int = 2) -> List[str]:
+    """BLS-pipeline errors: every PIPELINE_SPANS stage present with dur>0
+    under the same cid, for >= min_batches distinct cids."""
+    events = trace.get("traceEvents", trace) if isinstance(trace, dict) else trace
+    by_cid: Dict[Any, Dict[str, float]] = {}
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("ph") != "X":
+            continue
+        name = ev.get("name")
+        if name not in PIPELINE_SPANS:
+            continue
+        cid = (ev.get("args") or {}).get("cid", ev.get("id"))
+        if cid is None:
+            continue
+        stages = by_cid.setdefault(cid, {})
+        stages[name] = max(stages.get(name, 0.0), float(ev.get("dur", 0)))
+    complete = [
+        cid
+        for cid, stages in by_cid.items()
+        if all(stages.get(s, 0.0) > 0.0 for s in PIPELINE_SPANS)
+    ]
+    errors: List[str] = []
+    if len(complete) < min_batches:
+        errors.append(
+            f"pipeline: need >= {min_batches} batches with correlated non-zero "
+            f"{'/'.join(PIPELINE_SPANS)} spans, found {len(complete)} "
+            f"(partial batches: { {cid: sorted(st) for cid, st in by_cid.items()} })"
+        )
+    return errors
+
+
+def main(argv: List[str]) -> int:
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0 if argv else 1
+    path = argv[0]
+    min_batches = 2
+    require_pipeline = "--require-pipeline" in argv
+    if require_pipeline:
+        idx = argv.index("--require-pipeline")
+        if idx + 1 < len(argv) and argv[idx + 1].isdigit():
+            min_batches = int(argv[idx + 1])
+    try:
+        with open(path) as f:
+            trace = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"{path}: unreadable trace: {e}", file=sys.stderr)
+        return 1
+    errors = validate(trace)
+    if not errors and require_pipeline:
+        errors = validate_pipeline(trace, min_batches)
+    for err in errors:
+        print(f"{path}: {err}", file=sys.stderr)
+    if not errors:
+        n_events = len(trace.get("traceEvents", trace) if isinstance(trace, dict) else trace)
+        print(f"{path}: OK ({n_events} events)")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
