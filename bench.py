@@ -411,6 +411,84 @@ def bench_range_sync(time_budget_s: float = 240.0):
         return None
 
 
+def bench_multichip(time_budget_s: float = 420.0):
+    """Throughput scaling of the round-8 executor pool: whole merged
+    batches placed least-loaded/round-robin across N device executors vs
+    the same workload on 1 device (SURVEY §2.10 ICI data-parallel, rebuilt
+    as batch-level scheduling).  Publishes the north-star
+    ``sets_per_sec_per_chip`` plus ``scaling_efficiency`` =
+    rate(N)/(N * rate(1)).  Soft-skips (None) with < 2 devices or when the
+    per-device warmup would blow the stage budget."""
+    import time as _t
+
+    import jax
+
+    from lodestar_tpu import tracing
+    from lodestar_tpu.crypto.bls.api import interop_secret_key
+    from lodestar_tpu.crypto.bls.tpu_verifier import TpuBlsVerifier
+    from lodestar_tpu.crypto.bls.verifier import SingleSignatureSet
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return None
+    backend = jax.default_backend()
+    # CPU virtual devices share the host's cores — bucket 4 keeps the smoke
+    # test affordable; real TPUs measure the production block-sized bucket
+    bucket = 128 if backend == "tpu" else 4
+    default_n = len(devices) if backend == "tpu" else min(4, len(devices))
+    n_dev = min(len(devices), int(os.environ.get("BENCH_MULTICHIP_DEVICES", default_n)))
+    n_batches = 2 * n_dev
+    sets = []
+    for i in range(bucket):
+        sk = interop_secret_key(i % 8)  # repeated pubkeys: the cache-hit shape
+        msg = bytes([i % 256, i // 256]) * 16
+        sets.append(
+            SingleSignatureSet(
+                pubkey=sk.to_public_key(), signing_root=msg,
+                signature=sk.sign(msg).to_bytes(),
+            )
+        )
+
+    def throughput(verifier):
+        packed = verifier.pack(sets)
+        assert packed is not None
+        # warm every executor (compile/cache-load excluded from the rate)
+        warm = [verifier.dispatch(packed) for _ in range(verifier.n_devices)]
+        ok = all(p.result() for p in warm)
+        assert ok, "multichip warmup batch failed to verify"
+        t0 = _t.perf_counter()
+        pending = [verifier.dispatch(packed) for _ in range(n_batches)]
+        assert all(p.result() for p in pending)
+        dt = _t.perf_counter() - t0
+        return n_batches * len(sets) / dt
+
+    # tracing on for BOTH runs so the span overhead cancels out of
+    # scaling_efficiency (single-run spans carry device="default")
+    _enable_stage_trace()
+    t_start = _t.perf_counter()
+    single = TpuBlsVerifier(buckets=(bucket,))
+    rate1 = throughput(single)
+    if _t.perf_counter() - t_start > time_budget_s:
+        return None  # cold compile ate the budget; don't risk the wall clock
+    multi = TpuBlsVerifier(buckets=(bucket,), devices=devices[:n_dev])
+    rate_n = throughput(multi)
+    placed = {
+        (s.args or {}).get("device")
+        for s in tracing.TRACER.spans()
+        if s.name == "bls.dispatch"
+    } - {None, "default"}  # "default" = the single-device control run
+    return {
+        "n_devices": n_dev,
+        "bucket": bucket,
+        "sets_per_sec_1chip": round(rate1, 2),
+        "sets_per_sec_total": round(rate_n, 2),
+        "sets_per_sec_per_chip": round(rate_n / n_dev, 2),
+        "scaling_efficiency": round(rate_n / (n_dev * rate1), 3),
+        "devices_used": len(placed),
+        "trace_path": _dump_stage_trace("multichip"),
+    }
+
+
 def _enable_stage_trace() -> None:
     """Span-trace the e2e stages (ISSUE 2): each emits a Chrome-trace
     artifact whose path rides in the stage's extras."""
@@ -526,6 +604,21 @@ def main() -> None:
         errors["range_sync"] = err
     range_res = range_res or {}
     range_rate = range_res.get("rate")
+    # multichip scaling: CPU hosts need forced virtual devices; the flag is
+    # scoped to this one stage's subprocess (spawn children inherit env)
+    had_flags = "XLA_FLAGS" in os.environ
+    prev_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in prev_flags:
+        os.environ["XLA_FLAGS"] = (
+            prev_flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    multichip, err = _stage("bench_multichip", (), 480)
+    if had_flags:
+        os.environ["XLA_FLAGS"] = prev_flags
+    else:
+        os.environ.pop("XLA_FLAGS", None)
+    if err:
+        errors["multichip"] = err
     scale, err = _stage("bench_scale_250k", (), 420)
     if err:
         errors["scale_250k"] = err
@@ -560,6 +653,7 @@ def main() -> None:
                     "range_sync_stage_seconds": range_res.get("stage_seconds"),
                     "range_sync_inflight_peak": range_res.get("inflight_peak"),
                     "range_sync_trace": range_res.get("trace_path"),
+                    "multichip": multichip,
                     "scale_250k": scale,
                     "stage_errors": errors or None,
                     "backend": jax.default_backend(),

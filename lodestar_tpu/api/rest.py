@@ -1026,5 +1026,14 @@ class RestApiServer:
             "padding_wasted": getattr(verifier, "padding_wasted", 0),
             "host_final_exps": getattr(verifier, "host_final_exps", 0),
             "fused_fallbacks": getattr(verifier, "fused_fallbacks", 0),
+            # round-8 executor pool + pack caches
+            "n_devices": getattr(verifier, "n_devices", 1),
+            "device_inflight": (
+                verifier.device_inflight()
+                if hasattr(verifier, "device_inflight") else {}
+            ),
+            "pack_cache_hits": getattr(verifier, "pack_cache_hits", 0),
+            "pack_cache_misses": getattr(verifier, "pack_cache_misses", 0),
+            "pack_rejected": getattr(verifier, "pack_rejected", 0),
         }
         return {"data": data}

@@ -25,6 +25,12 @@ N-1's host final exponentiation runs — the pack/compute overlap the
 reference's BlsMultiThreadWorkerPool gets from N worker threads, rebuilt
 around ONE asynchronous device queue.  Verifiers without the async API
 get the same window via thread-pool concurrency.
+
+Round-8 multi-chip: ``pipeline_depth`` is PER DEVICE — the flush window
+is ``pipeline_depth * verifier.n_devices`` merged batches, so an 8-chip
+executor pool at depth 2 keeps 16 batches in flight and the verifier's
+least-loaded scheduler spreads them across the chips.  Single-device
+verifiers (n_devices absent or 1) behave exactly as before.
 """
 
 from __future__ import annotations
@@ -87,12 +93,17 @@ class BlsBatchPool:
 
     async def verify_signature_sets(self, sets: Sequence[SignatureSet], batchable: bool = True) -> bool:
         """Verify a job of sets; batchable jobs may wait up to
-        max_buffer_wait to share a dispatch with concurrent jobs."""
+        max_buffer_wait to share a dispatch with concurrent jobs.
+
+        An empty job raises (reference: multithread/index.ts throws on
+        empty) — this is the one seam through which an empty drain could
+        reach the verifier, and a silent False verdict here would read as
+        'invalid signature' to gossip validation."""
         if self._closed:
             raise RuntimeError("pool closed")
         sets = list(sets)
         if not sets:
-            return False
+            raise ValueError("verify_signature_sets: empty batch of signature sets")
         if not batchable:
             return await asyncio.to_thread(self.verifier.verify_signature_sets, sets)
         loop = asyncio.get_running_loop()
@@ -132,21 +143,27 @@ class BlsBatchPool:
             asyncio.get_running_loop().create_task(self._flush())
 
     async def _flush(self) -> None:
-        """Pipelined drain: keep up to ``pipeline_depth`` merged batches in
-        flight.  The fill half packs + enqueues batch N+1 (host CPU work on
-        a worker thread; the device dispatch itself is async) while the
-        drain half reads back the OLDEST in-flight batch's verdict — so the
-        host final exponentiation of batch N runs concurrently with the
-        device compute of batch N+1."""
+        """Pipelined drain: keep up to ``pipeline_depth * n_devices``
+        merged batches in flight.  The fill half packs + enqueues batch
+        N+1 (host CPU work on a worker thread; the device dispatch itself
+        is async) while the drain half reads back the OLDEST in-flight
+        batch's verdict — so the host final exponentiation of batch N runs
+        concurrently with the device compute of batch N+1, and a
+        multi-device verifier's scheduler sees enough batches to feed
+        every chip."""
         self._flushing = True
         use_async = hasattr(self.verifier, "verify_signature_sets_async")
         inflight: collections.deque = collections.deque()
         flush_t0 = time.monotonic()
         busy = 0.0  # sum of per-batch pack-start->verdict wall (overlap ratio)
+        sets_done = 0  # sets resolved this flush (per-chip throughput gauge)
+        # pipeline_depth is per device: a multi-chip executor pool wants
+        # enough batches in flight to keep every chip busy
+        window = self.pipeline_depth * max(1, getattr(self.verifier, "n_devices", 1))
         try:
             while len(self._queue) or inflight:
                 # fill the window
-                while len(self._queue) and len(inflight) < self.pipeline_depth:
+                while len(self._queue) and len(inflight) < window:
                     drained = self._queue.drain_batch(
                         max_items=1024, with_enqueue_time=True
                     )
@@ -178,6 +195,7 @@ class BlsBatchPool:
                     # verifier's pack/dispatch/final-exp spans pick it up
                     # without widening the IBlsVerifier API
                     t_fill = time.monotonic()  # batch busy starts at pack
+                    device = None
                     token = tracing.set_batch(cid)
                     try:
                         if use_async:
@@ -186,6 +204,9 @@ class BlsBatchPool:
                             pending = await asyncio.to_thread(
                                 self.verifier.verify_signature_sets_async, merged
                             )
+                            # executor name the scheduler picked (None for a
+                            # chunked batch spread over several devices)
+                            device = getattr(pending, "device", None)
                             verdict = asyncio.create_task(
                                 asyncio.to_thread(pending.result)
                             )
@@ -208,7 +229,7 @@ class BlsBatchPool:
                     finally:
                         tracing.reset_batch(token)
                     inflight.append(
-                        (jobs, merged, verdict, t_fill, time.monotonic(), cid)
+                        (jobs, merged, verdict, t_fill, time.monotonic(), cid, device)
                     )
                     self.inflight_peak = max(self.inflight_peak, len(inflight))
                     if self.metrics:
@@ -216,7 +237,7 @@ class BlsBatchPool:
                 if not inflight:
                     return
                 # drain the oldest batch
-                jobs, merged, verdict, t_fill, t0, cid = inflight.popleft()
+                jobs, merged, verdict, t_fill, t0, cid, device = inflight.popleft()
                 try:
                     ok = await verdict
                 except Exception as e:  # noqa: BLE001
@@ -226,11 +247,12 @@ class BlsBatchPool:
                 # busy counts from pack start so a fully serial pipeline
                 # reads ~1.0 (the documented baseline), overlap reads >1
                 busy += t_done - t_fill
+                sets_done += len(merged)
                 if TRACER.enabled:
                     TRACER.add_span(
                         "pool.batch", "pool", int(t_fill * 1e9), int(t_done * 1e9),
                         cid=cid, sets=len(merged), jobs=len(jobs), ok=bool(ok),
-                        inflight_left=len(inflight),
+                        inflight_left=len(inflight), device=device,
                     )
                 if self.metrics:
                     self.metrics.bls_pool_dispatch_seconds.observe(t_done - t0)
@@ -256,20 +278,25 @@ class BlsBatchPool:
                     fut.set_result(one)
         finally:
             self._flushing = False
-            self._publish_flush_metrics(busy, time.monotonic() - flush_t0)
+            self._publish_flush_metrics(busy, time.monotonic() - flush_t0, sets_done)
             if len(self._queue):
                 self._buffered_sets_changed()
 
-    def _publish_flush_metrics(self, busy: float, wall: float) -> None:
+    def _publish_flush_metrics(self, busy: float, wall: float, sets_done: int = 0) -> None:
         """End-of-flush snapshots: the overlap ratio this flush achieved,
-        plus the previously-orphaned verifier stage_seconds / pool
-        inflight_peak counters (ISSUE 2 satellite 1)."""
+        the previously-orphaned verifier stage_seconds / pool
+        inflight_peak counters (ISSUE 2 satellite 1), and the north-star
+        per-chip throughput of this flush (sets resolved / wall /
+        n_devices)."""
         if not self.metrics:
             return
         self.metrics.bls_pool_inflight_depth.set(0)
         self.metrics.bls_pool_inflight_peak.set(self.inflight_peak)
         if busy > 0 and wall > 0:
             self.metrics.bls_pool_overlap_ratio.set(busy / wall)
+        if sets_done and wall > 0:
+            n_dev = max(1, getattr(self.verifier, "n_devices", 1))
+            self.metrics.bls_sets_per_sec_per_chip.set(sets_done / wall / n_dev)
         stage_seconds = getattr(self.verifier, "stage_seconds", None)
         if stage_seconds:
             for stage, secs in stage_seconds.items():

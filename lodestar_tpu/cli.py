@@ -118,6 +118,20 @@ def build_parser() -> argparse.ArgumentParser:
             "(default: $LODESTAR_TPU_JAX_CACHE or repo-local .jax_cache)",
         )
         p.add_argument(
+            "--bls-devices", type=int, default=1,
+            help="device executors in the BLS pool: 1 = single device "
+            "(default), N = the first N local devices, 0 = every local "
+            "device; each chip gets its own AOT-compiled programs and the "
+            "scheduler places whole merged batches least-loaded "
+            "(docs/dispatch_pipeline.md)",
+        )
+        p.add_argument(
+            "--bls-point-cache-size", type=int, default=8192,
+            help="entries in the pack-stage LRU of decompressed/affine "
+            "points keyed by compressed bytes (0 disables; attestation "
+            "pubkeys and committee aggregates repeat epoch-to-epoch)",
+        )
+        p.add_argument(
             "--trace-dump", default=None, metavar="PATH",
             help="enable hot-path span tracing and write a Chrome trace-"
             "event JSON (open in Perfetto / chrome://tracing) to PATH on "
@@ -324,7 +338,21 @@ def _make_verifier(args):
         )
         fused_flag = getattr(args, "bls_fused", "auto")
         fused = None if fused_flag == "auto" else fused_flag == "on"
-        v = TpuBlsVerifier(buckets=buckets, fused=fused)
+        n_dev = getattr(args, "bls_devices", 1)
+        if n_dev < 0:
+            raise SystemExit(f"--bls-devices: expected 0 (all) or a positive count, got {n_dev}")
+        devices = None
+        if n_dev != 1:
+            import jax
+
+            local = jax.devices()
+            devices = local if n_dev == 0 else local[:n_dev]
+            logger.info("bls executor pool: %d of %d local devices",
+                        len(devices), len(local))
+        v = TpuBlsVerifier(
+            buckets=buckets, fused=fused, devices=devices,
+            point_cache_size=getattr(args, "bls_point_cache_size", 8192),
+        )
         warm = getattr(args, "bls_warmup", "background")
         profile_dir = getattr(args, "jax_profile", None)
         if profile_dir and warm != "off":

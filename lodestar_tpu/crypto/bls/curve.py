@@ -152,6 +152,45 @@ class Point(Generic[F]):
         return f"Point(infinity)" if aff is None else f"Point({aff[0]!r}, {aff[1]!r})"
 
 
+def batch_inverse(elems: list) -> list:
+    """Montgomery batch inversion: n field inverses for ONE actual
+    inversion plus 3(n-1) multiplications.  Works over any field element
+    type with ``*`` and ``.inv()`` (Fq and Fq2 here); all elements must be
+    nonzero and of the same type.
+
+    This is what makes the pack stage's per-set ``to_affine()`` affordable
+    at batch size: the bigint ``pow(a, p-2, p)`` is ~100x a multiplication,
+    so amortizing it across the batch collapses the Amdahl serial stage
+    (the analog of blst's blst_fp_inverse batching in Lodestar's pack
+    path)."""
+    if not elems:
+        return []
+    prefix = [elems[0]]
+    for e in elems[1:]:
+        prefix.append(prefix[-1] * e)
+    acc = prefix[-1].inv()
+    out: list = [None] * len(elems)
+    for i in range(len(elems) - 1, 0, -1):
+        out[i] = acc * prefix[i - 1]
+        acc = acc * elems[i]
+    out[0] = acc
+    return out
+
+
+def to_affine_batch(points: list) -> list:
+    """Affine (x, y) for many jacobian points with one field inversion via
+    ``batch_inverse`` over the Z coordinates.  Infinity points map to None
+    (callers reject them before packing).  All points must share a field
+    type — G1 and G2 batches are inverted separately."""
+    live = [(i, p) for i, p in enumerate(points) if not p.is_infinity()]
+    zinvs = batch_inverse([p.z for _, p in live])
+    out: list = [None] * len(points)
+    for (i, p), zi in zip(live, zinvs):
+        zi2 = zi.square()
+        out[i] = (p.x * zi2, p.y * zi2 * zi)
+    return out
+
+
 # -- generators (standard BLS12-381 generator points) -----------------------
 
 G1_GEN = Point.from_affine(

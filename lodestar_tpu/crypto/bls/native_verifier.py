@@ -42,7 +42,9 @@ class FastBlsVerifier:
 
     def verify_signature_sets(self, sets: Sequence[SignatureSet]) -> bool:
         if not sets:
-            return False
+            # contract parity across the IBlsVerifier boundary (TpuBlsVerifier,
+            # PyBlsVerifier, BlsBatchPool all raise; the reference throws)
+            raise ValueError("verify_signature_sets: empty batch of signature sets")
         if self._fallback is not None:
             return self._fallback.verify_signature_sets(sets)
         packed = []

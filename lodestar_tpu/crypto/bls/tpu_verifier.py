@@ -49,8 +49,13 @@ from ...ops import htc
 from ...ops import limbs as fl
 from ...tracing import TRACER, current_batch_id
 from ...utils.logger import get_logger
-from .curve import g2_from_bytes
-from .verifier import SignatureSet, get_aggregated_pubkey
+from .curve import g2_from_bytes, to_affine_batch
+from .verifier import (
+    PointCache,
+    SignatureSet,
+    SingleSignatureSet,
+    get_aggregated_pubkey,
+)
 
 logger = get_logger("tpu-verifier")
 
@@ -111,17 +116,24 @@ class PendingVerdict:
     dispatch is async) and ``result()`` performs the only synchronization
     — the device readback plus, on the split path, the host C final
     exponentiation.  ``result()`` is idempotent (the verdict is cached).
-    """
 
-    __slots__ = ("_verifier", "_f", "_ok", "_out", "_value", "_parts")
+    ``release`` is the scheduler's in-flight slot return: called exactly
+    once when the first ``result()`` completes, so the least-loaded
+    placement sees the device free again."""
 
-    def __init__(self, verifier=None, f=None, ok=None, out=None, value=None, parts=None):
+    __slots__ = ("_verifier", "_f", "_ok", "_out", "_value", "_parts", "_release",
+                 "device")
+
+    def __init__(self, verifier=None, f=None, ok=None, out=None, value=None,
+                 parts=None, release=None, device=None):
         self._verifier = verifier
         self._f = f
         self._ok = ok
         self._out = out
         self._value = value
         self._parts = parts
+        self._release = release
+        self.device = device  # executor name the batch landed on (None for chunked)
 
     def done_hint(self) -> bool:
         """True once the verdict is cached (no sync performed)."""
@@ -129,22 +141,50 @@ class PendingVerdict:
 
     def result(self) -> bool:
         if self._value is None:
-            if self._parts is not None:
-                results = [p.result() for p in self._parts]
-                self._value = all(results)
-            elif self._f is not None:
-                self._value = self._verifier._host_final_exp_verdict(self._f, self._ok)
-            else:
-                # fused on-device verdict: the bool() read is the sync; the
-                # span plays the final_exp role on this path's timeline
-                t0_ns = TRACER.now()
-                self._value = bool(self._out)
-                if TRACER.enabled:
-                    TRACER.add_span(
-                        "bls.final_exp", "bls", t0_ns,
-                        cid=current_batch_id(), on_device=True,
-                    )
+            try:
+                if self._parts is not None:
+                    results = [p.result() for p in self._parts]
+                    self._value = all(results)
+                elif self._f is not None:
+                    self._value = self._verifier._host_final_exp_verdict(self._f, self._ok)
+                else:
+                    # fused on-device verdict: the bool() read is the sync; the
+                    # span plays the final_exp role on this path's timeline
+                    t0_ns = TRACER.now()
+                    self._value = bool(self._out)
+                    if TRACER.enabled:
+                        TRACER.add_span(
+                            "bls.final_exp", "bls", t0_ns,
+                            cid=current_batch_id(), on_device=True,
+                        )
+            finally:
+                release, self._release = self._release, None
+                if release is not None:
+                    release()
         return self._value
+
+
+class DeviceExecutor:
+    """One chip's slice of the verifier: its own compiled programs (keyed
+    like the old single-device cache) plus an in-flight batch counter the
+    scheduler reads for least-loaded placement.
+
+    Each executor's programs are plain single-device ``jax.jit(...,
+    device=d)`` compilations — the fused Pallas kernels stay single-chip
+    programs (no Mosaic cross-chip lowering risk), and any bucket size
+    runs on any device count because batches are never sharded, only
+    placed."""
+
+    __slots__ = ("device", "index", "name", "inflight", "compiled")
+
+    def __init__(self, device=None, index: int = 0):
+        self.device = device  # None = default backend device (unpinned jit)
+        self.index = index
+        self.name = (
+            f"{device.platform}:{device.id}" if device is not None else "default"
+        )
+        self.inflight = 0
+        self.compiled = {}
 
 
 class TpuBlsVerifier:
@@ -161,10 +201,22 @@ class TpuBlsVerifier:
     is the automatic fallback when the C toolchain is absent, and
     ``host_final_exp=False`` restores the single fused device program.
 
-    Multi-device scale-out (``devices=[...]``): the batch axis is sharded
-    over a 1-D jax.sharding.Mesh, the ICI data-parallel story of SURVEY
-    §2.10 item 1 — production dispatch, not just the dryrun demo.  Buckets
-    that don't divide evenly fall back to single-device dispatch.
+    Multi-chip scale-out (``devices=[...]``, round-8): a ``DeviceExecutor``
+    per chip, each holding its own AOT-compiled programs, and a throughput
+    scheduler in ``dispatch()`` that places each whole packed batch on the
+    least-loaded device (round-robin tie-break).  This replaces the old
+    mesh-sharding-one-batch design: kernels stay single-chip programs, any
+    bucket works on any device count, and the pipeline depth multiplies by
+    ``n_devices`` (chain/bls_pool keeps ``pipeline_depth`` batches in
+    flight PER DEVICE).  Oversized batches chunk at ``buckets[-1]`` and
+    fan out across the pool (verify_signature_sets_async).
+
+    Pack-side caches (the Amdahl serial-stage attack): ``point_cache_size``
+    bounds an LRU of decompressed/affine points keyed by compressed bytes
+    (signatures, single pubkeys, and committee aggregates keyed by their
+    member bytes), and the remaining jacobian->affine conversions batch
+    through one Montgomery inversion per pack (curve.to_affine_batch)
+    instead of one bigint inversion per set.
 
     ``metrics``: optional Metrics registry; per-stage histograms
     (bls_pool_pack_seconds / bls_pool_dispatch_seconds is pool-side /
@@ -180,6 +232,7 @@ class TpuBlsVerifier:
         host_final_exp: bool = True,
         fused: Optional[bool] = None,
         metrics=None,
+        point_cache_size: int = 8192,
     ):
         self.buckets = tuple(sorted(buckets))
         self.platform = platform
@@ -190,7 +243,18 @@ class TpuBlsVerifier:
         # verifier never touches a JAX backend.
         self.fused = fused
         self.metrics = metrics
-        self._compiled = {}
+        # one executor per device; a single default executor otherwise
+        # (its device is resolved lazily at first jit so constructing a
+        # verifier still never touches a JAX backend)
+        if self.devices:
+            self._executors = [
+                DeviceExecutor(d, i) for i, d in enumerate(self.devices)
+            ]
+        else:
+            self._executors = [DeviceExecutor(None, 0)]
+        self._sched_lock = threading.Lock()
+        self._rr = 0  # round-robin tie-break cursor
+        self.point_cache = PointCache(point_cache_size)
         # pool-style counters (metrics parity with blsThreadPool.*,
         # metrics/metrics/lodestar.ts:385)
         self.dispatches = 0
@@ -198,7 +262,24 @@ class TpuBlsVerifier:
         self.padding_wasted = 0
         self.host_final_exps = 0
         self.fused_fallbacks = 0
+        self.pack_rejected = 0
+        self.pack_cache_hits = 0
+        self.pack_cache_misses = 0
         self.stage_seconds = {"pack": 0.0, "dispatch": 0.0, "final_exp": 0.0, "warmup": 0.0}
+
+    @property
+    def n_devices(self) -> int:
+        return len(self._executors)
+
+    @property
+    def _compiled(self):
+        """Primary executor's program cache — kept under the historical
+        name for callers/tests that inspect it."""
+        return self._executors[0].compiled
+
+    def device_inflight(self):
+        """Snapshot of per-device in-flight batch counts (debug API)."""
+        return {ex.name: ex.inflight for ex in self._executors}
 
     # -- compilation cache ---------------------------------------------------
 
@@ -226,31 +307,55 @@ class TpuBlsVerifier:
             else bv.verify_signature_sets_kernel
         )
 
-    def _jit(self, key):
+    def _jit(self, key, executor: DeviceExecutor):
         import jax
 
-        n = key[0]
         kernel = self._kernel(key)
-        if self.devices and len(self.devices) > 1 and n % len(self.devices) == 0:
-            from jax.sharding import Mesh, NamedSharding, PartitionSpec
-
-            # the multi-device dispatch stays on the XLA-graph kernels:
-            # the batch axis shards cleanly there, while the fused
-            # path's merged ladders are single-chip programs
-            kernel = self._kernel((n, key[1], False))
-            mesh = Mesh(np.array(self.devices), ("sets",))
-            batch = NamedSharding(mesh, PartitionSpec("sets"))
-            return jax.jit(kernel, in_shardings=(batch,) * 7)
-        if self.platform is not None:
+        device = executor.device
+        if device is None and self.platform is not None:
             device = jax.devices(self.platform)[0]
+        if device is not None:
             return jax.jit(kernel, device=device)
         return jax.jit(kernel)
 
-    def _fn(self, n: int, fused: Optional[bool] = None):
+    def _fn(self, n: int, fused: Optional[bool] = None,
+            executor: Optional[DeviceExecutor] = None):
         key = (n, self.host_final_exp, self._resolve_fused() if fused is None else fused)
-        if key not in self._compiled:
-            self._compiled[key] = self._jit(key)
-        return self._compiled[key]
+        ex = executor if executor is not None else self._executors[0]
+        if key not in ex.compiled:
+            ex.compiled[key] = self._jit(key, ex)
+        return ex.compiled[key]
+
+    # -- scheduling -----------------------------------------------------------
+
+    def _acquire_executor(self) -> DeviceExecutor:
+        """Least-loaded placement with a rotating round-robin tie-break, so
+        equal-load devices are fed in rotation rather than always device 0.
+        The in-flight increment happens under the same lock as the pick —
+        concurrent dispatch threads can't double-book a device."""
+        with self._sched_lock:
+            k = len(self._executors)
+            if k == 1:
+                ex = self._executors[0]
+            else:
+                start = self._rr
+                self._rr = (self._rr + 1) % k
+                ex = min(
+                    (self._executors[(start + i) % k] for i in range(k)),
+                    key=lambda e: e.inflight,
+                )
+            ex.inflight += 1
+            inflight = ex.inflight
+        if self.metrics:
+            self.metrics.bls_device_inflight.labels(device=ex.name).set(inflight)
+        return ex
+
+    def _release_executor(self, ex: DeviceExecutor) -> None:
+        with self._sched_lock:
+            ex.inflight -= 1
+            inflight = ex.inflight
+        if self.metrics:
+            self.metrics.bls_device_inflight.labels(device=ex.name).set(inflight)
 
     def _abstract_args(self, n: int):
         """ShapeDtypeStructs matching pack() output — AOT lowering inputs."""
@@ -271,8 +376,9 @@ class TpuBlsVerifier:
 
     def warmup(self, buckets: Optional[Sequence[int]] = None) -> float:
         """AOT-compile the dispatch program for every bucket of the active
-        path (``jit(...).lower(...).compile()``), populating both the
-        in-process executable cache and the persistent compilation cache.
+        path (``jit(...).lower(...).compile()``) on EVERY device executor,
+        populating both the in-process executable caches and the persistent
+        compilation cache.
 
         Returns the wall seconds spent.  A bucket whose compile FAILS
         (e.g. a Mosaic lowering bug in the fused path) degrades that
@@ -281,24 +387,30 @@ class TpuBlsVerifier:
         t0 = time.perf_counter()
         for b in tuple(buckets if buckets is not None else self.buckets):
             key = (b, self.host_final_exp, self._resolve_fused())
-            if key in self._compiled and not hasattr(self._compiled[key], "lower"):
-                continue  # already an AOT executable
-            try:
-                self._compiled[key] = self._jit(key).lower(
-                    *self._abstract_args(b)
-                ).compile()
-            except Exception as e:  # noqa: BLE001
-                logger.warning("warmup compile failed for bucket %d: %s", b, e)
-                if self.fused:
-                    logger.warning("degrading to XLA-graph kernels (fused=False)")
-                    self.fused = False
-                    self.fused_fallbacks += 1
-                    self._compiled.pop(key, None)
-                    return self.warmup(buckets) + (time.perf_counter() - t0)
+            for ex in self._executors:
+                if key in ex.compiled and not hasattr(ex.compiled[key], "lower"):
+                    continue  # already an AOT executable
+                try:
+                    ex.compiled[key] = self._jit(key, ex).lower(
+                        *self._abstract_args(b)
+                    ).compile()
+                except Exception as e:  # noqa: BLE001
+                    logger.warning(
+                        "warmup compile failed for bucket %d on %s: %s",
+                        b, ex.name, e,
+                    )
+                    if self.fused:
+                        logger.warning("degrading to XLA-graph kernels (fused=False)")
+                        self.fused = False
+                        self.fused_fallbacks += 1
+                        for e2 in self._executors:
+                            e2.compiled.pop(key, None)
+                        return self.warmup(buckets) + (time.perf_counter() - t0)
         dt = time.perf_counter() - t0
         self.stage_seconds["warmup"] += dt
         if TRACER.enabled:
-            TRACER.instant("bls.warmup_done", cat="bls", seconds=round(dt, 3))
+            TRACER.instant("bls.warmup_done", cat="bls", seconds=round(dt, 3),
+                           devices=self.n_devices)
         return dt
 
     def warmup_async(self, buckets: Optional[Sequence[int]] = None) -> threading.Thread:
@@ -365,11 +477,17 @@ class TpuBlsVerifier:
     ) -> PendingVerdict:
         """Pack + enqueue without waiting for the device: the returned
         handle's ``result()`` is the only sync.  Oversized batches chunk
-        at the largest bucket with every chunk enqueued back-to-back, so
+        at the largest bucket with every chunk enqueued back-to-back —
         chunk N+1's pack overlaps chunk N's device time even on the
-        single-caller path."""
+        single-caller path, and on a multi-device pool the scheduler fans
+        the chunks out round-robin across the executors.
+
+        An empty batch is a caller bug, not a verification failure — the
+        reference throws (multithread/index.ts verifySignatureSets), and a
+        silent False verdict here would poison retry-individually logic
+        upstream."""
         if not sets:
-            return PendingVerdict(value=False)
+            raise ValueError("verify_signature_sets_async: empty batch of signature sets")
         largest = self.buckets[-1]
         if len(sets) > largest:
             # split oversized batches (chunkify analog, multithread/utils.ts:4)
@@ -384,8 +502,12 @@ class TpuBlsVerifier:
         return self.dispatch(packed)
 
     def dispatch(self, packed) -> PendingVerdict:
-        """Enqueue one packed batch on the device — returns immediately
-        (the jax dispatch is asynchronous; compile, if cold, is not).
+        """Place one packed batch on the least-loaded device executor and
+        enqueue it — returns immediately (the jax dispatch is
+        asynchronous; compile, if cold, is not).  The executor's in-flight
+        slot is held until the verdict's first ``result()`` completes, so
+        back-to-back dispatches (chunked range-sync batches, pipelined
+        pool flushes) spread across the device pool.
 
         A compile failure on the fused path (Mosaic lowering) degrades
         this verifier to the XLA-graph kernels and retries once — a bad
@@ -398,64 +520,134 @@ class TpuBlsVerifier:
         # may degrade self.fused mid-flight, and the except arm must judge
         # the path that actually raised, not the flag's latest value
         used_fused = self._resolve_fused()
+        ex = self._acquire_executor()
         try:
-            out = self._fn(n, fused=used_fused)(*packed)
-        except Exception as e:  # noqa: BLE001
-            if not used_fused:
-                raise
-            logger.warning("fused dispatch failed (%s); degrading to XLA kernels", e)
-            self.fused = False
-            self.fused_fallbacks += 1
-            out = self._fn(n, fused=False)(*packed)
+            try:
+                out = self._fn(n, fused=used_fused, executor=ex)(*packed)
+            except Exception as e:  # noqa: BLE001
+                if not used_fused:
+                    raise
+                logger.warning("fused dispatch failed (%s); degrading to XLA kernels", e)
+                self.fused = False
+                self.fused_fallbacks += 1
+                out = self._fn(n, fused=False, executor=ex)(*packed)
+        except Exception:
+            self._release_executor(ex)
+            raise
         if TRACER.enabled:
             # covers the async enqueue only (plus compile when cold); the
-            # device compute itself surfaces as the gap before final_exp
+            # device compute itself surfaces as the gap before final_exp.
+            # device/devices_total let tools/check_trace.py assert a
+            # multi-device dump actually spread across the pool
             TRACER.add_span("bls.dispatch", "bls", t0_ns,
-                            cid=current_batch_id(), bucket=n, fused=used_fused)
+                            cid=current_batch_id(), bucket=n, fused=used_fused,
+                            device=ex.name, devices_total=self.n_devices)
+        release = lambda: self._release_executor(ex)  # noqa: E731
         if self.host_final_exp:
             f, ok = out
-            return PendingVerdict(verifier=self, f=f, ok=ok)
-        return PendingVerdict(verifier=self, out=out)
+            return PendingVerdict(verifier=self, f=f, ok=ok, release=release,
+                                  device=ex.name)
+        return PendingVerdict(verifier=self, out=out, release=release,
+                              device=ex.name)
 
     def close(self) -> None:
-        self._compiled.clear()
+        for ex in self._executors:
+            ex.compiled.clear()
 
     # -- packing -------------------------------------------------------------
+
+    def _pack_reject(self):
+        """Accounting for a rejected batch (malformed bytes / infinity):
+        only the rejection counter moves — padding and the pack histogram
+        count successful packs exclusively (a rejected batch never
+        dispatches, so its padding was never 'wasted' on a device)."""
+        self.pack_rejected += 1
+        if self.metrics:
+            self.metrics.bls_pack_rejected_total.inc()
+        return None
 
     def pack(self, sets: Sequence[SignatureSet]):
         """Host packing stage, numpy-vectorized: ONE bulk byte->limb
         conversion per coordinate family (ops/limbs.ints_to_limbs) and a
         vectorized RLC bit expansion instead of per-element/per-bit Python
         loops.  Returns the 7-tuple of device-ready arrays, or None when
-        any set is malformed (infinity pubkey/signature, bad bytes)."""
+        any set is malformed (infinity pubkey/signature, bad bytes).
+
+        Round-8 serial-stage attack: affine coordinates come from the
+        ``point_cache`` LRU (keyed by compressed signature bytes, single
+        pubkey bytes, or an aggregate's concatenated member bytes) and the
+        misses convert jacobian->affine through ONE Montgomery batch
+        inversion per family (curve.to_affine_batch) instead of one bigint
+        inversion per set."""
         t0 = time.perf_counter()
         t0_ns = TRACER.now()
+        hits = misses = 0
         try:
             n = len(sets)
             b = self._bucket(n)
-            self.padding_wasted += b - n
-            pk_ints: List[int] = []
-            sig_ints: List[int] = []
+            cache = self.point_cache
+            pk_vals: List[Optional[tuple]] = [None] * n
+            sig_vals: List[Optional[tuple]] = [None] * n
+            pk_miss: List[tuple] = []   # (index, jacobian point, cache key | None)
+            sig_miss: List[tuple] = []
             msgs: List[bytes] = []
-            for s in sets:
-                pk = get_aggregated_pubkey(s)
-                if pk.is_infinity():
-                    return None
-                try:
-                    # on-curve guaranteed by sqrt decompression; subgroup
-                    # check happens on device (batched)
-                    sig_pt = g2_from_bytes(s.signature, subgroup_check=False)
-                except ValueError:
-                    return None
-                if sig_pt.is_infinity():
-                    return None
-                pk_aff = pk.point.to_affine()
-                sig_aff = sig_pt.to_affine()
-                pk_ints += [pk_aff[0].n, pk_aff[1].n]
-                sig_ints += [
-                    sig_aff[0].c0, sig_aff[0].c1, sig_aff[1].c0, sig_aff[1].c1
-                ]
+            for i, s in enumerate(sets):
+                # -- pubkey: single keys cache by their compressed bytes,
+                #    aggregates by the concatenation of member bytes (the
+                #    same committee re-aggregates every epoch) -------------
+                if isinstance(s, SingleSignatureSet):
+                    pk_key = s.pubkey._raw
+                    if pk_key is not None:
+                        pk_key = b"P" + pk_key
+                elif cache.enabled:
+                    pk_key = b"A" + b"".join(m.to_bytes() for m in s.pubkeys)
+                else:
+                    pk_key = None
+                hit = cache.get(pk_key) if pk_key is not None else None
+                if hit is not None:
+                    pk_vals[i] = hit
+                    hits += 1
+                else:
+                    misses += 1
+                    pk = get_aggregated_pubkey(s)
+                    if pk.is_infinity():
+                        return self._pack_reject()
+                    pk_miss.append((i, pk.point, pk_key))
+                # -- signature --------------------------------------------
+                raw = s.signature
+                hit = cache.get(b"S" + raw) if cache.enabled else None
+                if hit is not None:
+                    sig_vals[i] = hit
+                    hits += 1
+                else:
+                    misses += 1
+                    try:
+                        # on-curve guaranteed by sqrt decompression; subgroup
+                        # check happens on device (batched)
+                        sig_pt = g2_from_bytes(raw, subgroup_check=False)
+                    except ValueError:
+                        return self._pack_reject()
+                    if sig_pt.is_infinity():
+                        return self._pack_reject()
+                    sig_miss.append((i, sig_pt, b"S" + raw))
                 msgs.append(s.signing_root)
+            # one Montgomery batch inversion per coordinate family
+            for aff, missed in (
+                (to_affine_batch([pt for _, pt, _ in pk_miss]), pk_miss),
+                (to_affine_batch([pt for _, pt, _ in sig_miss]), sig_miss),
+            ):
+                for (i, _pt, key), xy in zip(missed, aff):
+                    x, y = xy
+                    if hasattr(x, "n"):  # Fq (G1 pubkey)
+                        val = (x.n, y.n)
+                        pk_vals[i] = val
+                    else:  # Fq2 (G2 signature)
+                        val = (x.c0, x.c1, y.c0, y.c1)
+                        sig_vals[i] = val
+                    if key is not None:
+                        cache.put(key, val)
+            pk_ints: List[int] = [c for v in pk_vals for c in v]
+            sig_ints: List[int] = [c for v in sig_vals for c in v]
             # one batched byte->limb conversion per family
             pk_limbs = fl.ints_to_limbs(pk_ints).reshape(n, 2, fl.NLIMBS)
             sig_limbs = fl.ints_to_limbs(sig_ints).reshape(n, 2, 2, fl.NLIMBS)
@@ -482,15 +674,25 @@ class TpuBlsVerifier:
             ).astype(fl.NP_DTYPE)
             mask = np.zeros(b, dtype=bool)
             mask[:n] = True
+            # padding counts only for batches that will actually dispatch
+            self.padding_wasted += b - n
+            if self.metrics:
+                self.metrics.bls_pool_pack_seconds.observe(time.perf_counter() - t0)
             return (pk_x, pk_y, sig_x, sig_y, msg_u, bits, mask)
         finally:
             dt = time.perf_counter() - t0
             self.stage_seconds["pack"] += dt
+            self.pack_cache_hits += hits
+            self.pack_cache_misses += misses
             if self.metrics:
-                self.metrics.bls_pool_pack_seconds.observe(dt)
+                if hits:
+                    self.metrics.bls_pack_cache_hits_total.inc(hits)
+                if misses:
+                    self.metrics.bls_pack_cache_misses_total.inc(misses)
             if TRACER.enabled:
                 TRACER.add_span("bls.pack", "bls", t0_ns,
-                                cid=current_batch_id(), sets=len(sets))
+                                cid=current_batch_id(), sets=len(sets),
+                                cache_hits=hits)
 
     # kept for callers/tests that used the private name
     _pack = pack

@@ -15,8 +15,10 @@ Implementations:
 
 from __future__ import annotations
 
+import collections
 import dataclasses
-from typing import List, Protocol, Sequence, Union
+import threading
+from typing import List, Optional, Protocol, Sequence, Tuple, Union
 
 from .api import (
     PublicKey,
@@ -48,10 +50,72 @@ SignatureSet = Union[SingleSignatureSet, AggregatedSignatureSet]
 
 
 def get_aggregated_pubkey(s: SignatureSet) -> PublicKey:
-    """Reference: chain/bls/utils.ts:5 (jacobian-sum aggregation on host)."""
+    """Reference: chain/bls/utils.ts:5 (jacobian-sum aggregation on host).
+
+    Memoized per SignatureSet identity: a set re-verified after a failed
+    merged batch (the pool's retry-individually path) or re-packed after a
+    dispatch failure pays the jacobian sum once.  The memo rides in the
+    instance ``__dict__`` so it dies with the set object."""
     if isinstance(s, SingleSignatureSet):
         return s.pubkey
-    return aggregate_pubkeys(s.pubkeys)
+    cached = s.__dict__.get("_agg_pubkey")
+    if cached is None:
+        cached = aggregate_pubkeys(s.pubkeys)
+        s.__dict__["_agg_pubkey"] = cached
+    return cached
+
+
+class PointCache:
+    """Thread-safe LRU of pack-ready affine coordinates keyed by compressed
+    point bytes.
+
+    Attestation pubkeys and committee aggregates repeat heavily epoch to
+    epoch (the analog of Lodestar's deserialized-pubkey caching,
+    state-transition/src/cache/pubkeyCache.ts): a hit skips the G2 sqrt
+    decompression or the G1 jacobian aggregation AND the jacobian->affine
+    inversion entirely.  ``maxsize <= 0`` disables the cache (every lookup
+    misses, nothing is stored).  Values are plain int tuples — immutable,
+    safe to share across threads."""
+
+    __slots__ = ("maxsize", "hits", "misses", "_lock", "_data")
+
+    def __init__(self, maxsize: int = 8192):
+        self.maxsize = maxsize
+        self.hits = 0
+        self.misses = 0
+        self._lock = threading.Lock()
+        self._data: "collections.OrderedDict[bytes, Tuple[int, ...]]" = (
+            collections.OrderedDict()
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self.maxsize > 0
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def get(self, key: bytes) -> Optional[Tuple[int, ...]]:
+        if self.maxsize <= 0:
+            self.misses += 1
+            return None
+        with self._lock:
+            val = self._data.get(key)
+            if val is None:
+                self.misses += 1
+                return None
+            self._data.move_to_end(key)
+            self.hits += 1
+            return val
+
+    def put(self, key: bytes, value: Tuple[int, ...]) -> None:
+        if self.maxsize <= 0:
+            return
+        with self._lock:
+            self._data[key] = value
+            self._data.move_to_end(key)
+            while len(self._data) > self.maxsize:
+                self._data.popitem(last=False)
 
 
 class IBlsVerifier(Protocol):
@@ -74,6 +138,11 @@ class PyBlsVerifier:
         self.batch_sigs_success = 0
 
     def verify_signature_sets(self, sets: Sequence[SignatureSet]) -> bool:
+        if not sets:
+            # same contract as TpuBlsVerifier (and the reference, which
+            # throws): an empty batch is a caller bug — all() of an empty
+            # generator would read as "all signatures valid"
+            raise ValueError("verify_signature_sets: empty batch of signature sets")
         try:
             triples = [_deserialize(s) for s in sets]
         except ValueError:

@@ -145,6 +145,33 @@ class Metrics:
             "(TpuBlsVerifier.stage_seconds snapshot, updated on flush)",
             labels=("stage",),
         )
+        # multi-chip executor pool + pack-side caches (round 8)
+        self.bls_device_inflight = r.gauge(
+            "lodestar_bls_device_inflight",
+            "merged batches in flight per device executor "
+            "(the least-loaded scheduler's placement signal)",
+            labels=("device",),
+        )
+        self.bls_sets_per_sec_per_chip = r.gauge(
+            "lodestar_bls_sets_per_sec_per_chip",
+            "signature sets resolved per second per device in the last "
+            "pool flush — the BASELINE.json north star, live",
+        )
+        self.bls_pack_cache_hits_total = r.counter(
+            "lodestar_bls_pack_cache_hits_total",
+            "pack-stage point-cache hits (affine point reused, "
+            "decompression/aggregation/inversion skipped)",
+        )
+        self.bls_pack_cache_misses_total = r.counter(
+            "lodestar_bls_pack_cache_misses_total",
+            "pack-stage point-cache misses (full decompression + batched "
+            "inversion paid)",
+        )
+        self.bls_pack_rejected_total = r.counter(
+            "lodestar_bls_pack_rejected_total",
+            "pack-stage rejections (malformed bytes or infinity point; "
+            "the batch never dispatched)",
+        )
         # chain
         self.block_processing_seconds = r.histogram(
             "lodestar_block_processing_seconds",

@@ -32,3 +32,97 @@ jax.config.update("jax_platforms", "cpu")
 # host; caching them across pytest processes keeps the suite re-runnable.
 jax.config.update("jax_compilation_cache_dir", os.path.join(_REPO_ROOT, ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+# ---------------------------------------------------------------------------
+# jit-compile budget guard
+#
+# Tier-1 runs under a hard wall clock dominated by XLA compiles of the BLS
+# kernel graphs; the persistent cache amortizes them ONLY partially (a
+# warm-cache load of a big program still pays trace + lower + deserialize,
+# and the backend_compile event fires for it too).  A test that
+# materializes an expensive device program (>= 1.0s, compiled OR loaded)
+# must be on the explicit whitelist below, or it fails with instructions.
+# Tiny throwaway jits (< 1.0s) are exempt.  Escape hatch:
+# LODESTAR_TPU_COMPILE_GUARD=0.
+# ---------------------------------------------------------------------------
+
+import fnmatch  # noqa: E402
+
+import pytest  # noqa: E402
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_COMPILE_BUDGET_SECS = 1.0  # mirrors jax_persistent_cache_min_compile_time_secs
+_compile_log = []  # durations of expensive backend compiles, in test order
+
+
+def _count_backend_compiles(event, duration, **kwargs):
+    if event == _COMPILE_EVENT and duration >= _COMPILE_BUDGET_SECS:
+        _compile_log.append(duration)
+
+
+jax.monitoring.register_event_duration_secs_listener(_count_backend_compiles)
+
+# Modules allowed to add device programs (the kernel suites themselves and
+# the e2e tests that drive them; everything else must ride the cache or use
+# a fake stage verifier — see tests/test_tracing.py StageTracedVerifier).
+COMPILE_WHITELIST = (
+    "tests/test_ops_*.py::*",
+    "tests/test_fused_*.py::*",
+    "tests/test_pallas_*.py::*",
+    "tests/test_tpu_verifier.py::*",
+    "tests/test_dev_chain_tpu.py::*",
+    "tests/test_multidevice_scheduler.py::*",
+    "tests/test_rfc9380_vectors.py::TestHashToG2Device::*",
+)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    session.config._lodestar_exitstatus = int(exitstatus)
+
+
+def pytest_unconfigure(config):
+    """Hard-exit once the session is fully reported.
+
+    Interpreter shutdown after a full suite costs 15-20s on this image
+    (JAX backend finalization + GC of device arrays across 8 virtual
+    devices) — enough to push an otherwise-passing run past tier-1's hard
+    870s timeout AFTER the summary has printed.  Nothing meaningful runs
+    after this point (the persistent compile cache writes at compile
+    time, not at exit), so skip the shutdown entirely.  Disable with
+    LODESTAR_TPU_FAST_EXIT=0."""
+    if os.environ.get("LODESTAR_TPU_FAST_EXIT", "1") in ("0", "false", "no"):
+        return
+    # os._exit skips atexit — never fast-exit under coverage (its data file
+    # is saved by an atexit hook) or any cov plugin, which would silently
+    # record 0% coverage
+    if os.environ.get("COVERAGE_RUN") or config.pluginmanager.hasplugin("_cov"):
+        return
+    status = getattr(config, "_lodestar_exitstatus", None)
+    if status is None:
+        return
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(status)
+
+
+@pytest.fixture(autouse=True)
+def _compile_budget_guard(request):
+    before = len(_compile_log)
+    yield
+    added = _compile_log[before:]
+    if not added:
+        return
+    if os.environ.get("LODESTAR_TPU_COMPILE_GUARD", "1") in ("0", "false", "no"):
+        return
+    nodeid = request.node.nodeid
+    if any(fnmatch.fnmatch(nodeid, pat) for pat in COMPILE_WHITELIST):
+        return
+    pytest.fail(
+        f"{nodeid} compiled {len(added)} new device program(s) "
+        f"({', '.join(f'{d:.1f}s' for d in added)}) outside the compile "
+        f"whitelist — tier-1 is XLA-compile-bound (870s cap). Reuse an "
+        f"already-compiled bucket, use a stage-fake verifier, mark the test "
+        f"slow, or add the module to COMPILE_WHITELIST in tests/conftest.py "
+        f"with a budget justification.",
+        pytrace=False,
+    )

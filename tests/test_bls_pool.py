@@ -91,10 +91,11 @@ class TestPool:
 
         run(main())
 
-    def test_empty_job_false(self):
+    def test_empty_job_raises(self):
         async def main():
             pool = BlsBatchPool(CountingVerifier())
-            assert not await pool.verify_signature_sets([])
+            with pytest.raises(ValueError):
+                await pool.verify_signature_sets([])
             pool.close()
 
         run(main())

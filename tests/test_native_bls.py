@@ -116,7 +116,8 @@ def test_fast_verifier_rejects_malformed():
     assert not v.verify_signature_sets(
         [SingleSignatureSet(pubkey=pk, signing_root=b"\x00" * 32, signature=inf)]
     )
-    assert not v.verify_signature_sets([])
+    with pytest.raises(ValueError):
+        v.verify_signature_sets([])
 
 
 def test_batch_verify_agreement_with_oracle_batcher():

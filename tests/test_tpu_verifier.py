@@ -81,8 +81,13 @@ class TestTpuVerifierMatrix:
         sets[0].signature = b"\x00" * 96
         assert not verifier.verify_signature_sets(sets)
 
-    def test_empty_batch_false(self, verifier):
-        assert not verifier.verify_signature_sets([])
+    def test_empty_batch_raises(self, verifier):
+        # reference parity: multithread/index.ts throws on an empty job; a
+        # silent False verdict would read as "invalid signature" upstream
+        with pytest.raises(ValueError):
+            verifier.verify_signature_sets([])
+        with pytest.raises(ValueError):
+            verifier.verify_signature_sets_async([])
 
     def test_padding_lanes_do_not_leak(self, verifier):
         # bucket 4 with 2 live sets: padding copies lane 0; a bad lane 0

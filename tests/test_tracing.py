@@ -290,7 +290,8 @@ class TestPoolPipelineSpans:
         assert packed is not None
         spans = [s for s in TRACER.spans() if s.name == "bls.pack"]
         assert len(spans) == 1
-        assert spans[0].dur_ns > 0 and spans[0].args == {"sets": 2}
+        assert spans[0].dur_ns > 0
+        assert spans[0].args == {"sets": 2, "cache_hits": 0}
         assert spans[0].cid is None  # no pool context here
 
     def test_clock_slot_annotations(self):

@@ -18,7 +18,11 @@ and Perfetto actually require to load a file):
 full BLS span taxonomy — ``bls.queue_wait`` / ``bls.pack`` /
 ``bls.dispatch`` / ``bls.final_exp`` — with non-zero durations, batch-
 correlated (same ``args.cid``) for at least N distinct merged batches
-(default 2).  This is the acceptance gate for a ``--trace-dump`` dev-chain
+(default 2).  When the dump comes from a multi-device executor pool
+(any ``bls.dispatch`` span carries ``args.devices_total > 1``) it also
+asserts the dispatches landed on >= 2 distinct ``args.device`` ids — a
+pool that funnels every batch to one chip is a scheduler bug, not a
+pipeline.  This is the acceptance gate for a ``--trace-dump`` dev-chain
 run; tests/test_tracing.py drives it in-process.
 
 Exit 0 on success; exit 1 with one error per line on failure.
@@ -75,16 +79,25 @@ def validate(trace: Any) -> List[str]:
 
 def validate_pipeline(trace: Any, min_batches: int = 2) -> List[str]:
     """BLS-pipeline errors: every PIPELINE_SPANS stage present with dur>0
-    under the same cid, for >= min_batches distinct cids."""
+    under the same cid, for >= min_batches distinct cids; and, for a
+    multi-device dump (dispatch spans carrying ``devices_total > 1``),
+    dispatches spread over >= 2 distinct device ids."""
     events = trace.get("traceEvents", trace) if isinstance(trace, dict) else trace
     by_cid: Dict[Any, Dict[str, float]] = {}
+    devices_seen = set()
+    devices_total = 1
     for ev in events:
         if not isinstance(ev, dict) or ev.get("ph") != "X":
             continue
         name = ev.get("name")
         if name not in PIPELINE_SPANS:
             continue
-        cid = (ev.get("args") or {}).get("cid", ev.get("id"))
+        args = ev.get("args") or {}
+        if name == "bls.dispatch":
+            devices_total = max(devices_total, int(args.get("devices_total", 1)))
+            if args.get("device") is not None:
+                devices_seen.add(args["device"])
+        cid = args.get("cid", ev.get("id"))
         if cid is None:
             continue
         stages = by_cid.setdefault(cid, {})
@@ -100,6 +113,12 @@ def validate_pipeline(trace: Any, min_batches: int = 2) -> List[str]:
             f"pipeline: need >= {min_batches} batches with correlated non-zero "
             f"{'/'.join(PIPELINE_SPANS)} spans, found {len(complete)} "
             f"(partial batches: { {cid: sorted(st) for cid, st in by_cid.items()} })"
+        )
+    if devices_total > 1 and len(devices_seen) < 2:
+        errors.append(
+            f"pipeline: multi-device dump (devices_total={devices_total}) but "
+            f"dispatches landed on {sorted(devices_seen)} — expected >= 2 "
+            f"distinct device ids"
         )
     return errors
 
