@@ -48,6 +48,25 @@ def build_batch(n: int):
     return example_inputs(n)
 
 
+def bench_lint():
+    """Pre-flight invariant lint (tools/lint.py run_all): AST rules, the
+    lock/race audit, and the jaxpr IR audit of every fused entry point at
+    the production bucket pair.
+
+    Returns the violation dicts.  The gate RECORDS them in extras.lint
+    instead of silently proceeding — a Mosaic-unsafe splice or an
+    unlocked hot-path mutation must be visible in the bench artifact even
+    on a run whose numbers look fine (BENCH_r05 was exactly a lint-class
+    failure surfacing as rc=124).  Runs CPU-only in its own spawn child:
+    tracing never needs the TPU, and the real device stages must not
+    contend with it for the device lock."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from lodestar_tpu.analysis import run_all
+    from lodestar_tpu.analysis.report import to_dicts
+
+    return to_dicts(run_all(repo=_REPO))
+
+
 def bench_pallas_fused(args, repeats: int = 3):
     """The round-5 production path: fused Pallas kernel dispatch, final
     exponentiation on device (ops/fused_verify.verify_signature_sets_fused)."""
@@ -564,8 +583,13 @@ def _stage(fn_name, args=(), timeout_s=600.0, retries=1):
 
 
 def main() -> None:
-    args = build_batch(BATCH)
     errors = {}
+    # pre-flight lint: violations ride extras.lint (never a dead gate —
+    # a broken invariant should show up NEXT TO the numbers it taints)
+    lint_violations, lint_err = _stage("bench_lint", (), 420)
+    if lint_err:
+        errors["lint"] = lint_err
+    args = build_batch(BATCH)
     modes = []
 
     def run_mode(name, fn_name, timeout_s):
@@ -655,6 +679,10 @@ def main() -> None:
                     "range_sync_trace": range_res.get("trace_path"),
                     "multichip": multichip,
                     "scale_250k": scale,
+                    "lint": {
+                        "violations": lint_violations,
+                        "count": len(lint_violations) if lint_violations is not None else None,
+                    },
                     "stage_errors": errors or None,
                     "backend": jax.default_backend(),
                 },

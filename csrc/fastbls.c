@@ -835,6 +835,63 @@ static void g2_mul(g2_t *r, const g2_t *p, const uint64_t e[4]) {
     *r = acc;
 }
 
+/* branchless r = bit ? a : b over the 36 limbs (3 fp2 = 6 fp x 6 limbs)
+ * of a jacobian g2 point */
+static void g2_csel(g2_t *r, const g2_t *a, const g2_t *b, uint64_t bit) {
+    uint64_t mask = (uint64_t)0 - (bit & 1);
+    const uint64_t *pa = (const uint64_t *)a;
+    const uint64_t *pb = (const uint64_t *)b;
+    uint64_t *pr = (uint64_t *)r;
+    for (size_t i = 0; i < sizeof(g2_t) / sizeof(uint64_t); i++)
+        pr[i] = (pa[i] & mask) | (pb[i] & ~mask);
+}
+
+/* out = e + r (+ r again, branchlessly, while bit 255 is still clear).
+ * For e in [1, r): out == e (mod r), out < 2^256, and bit 255 is ALWAYS
+ * set — so a fixed 256-bit ladder can start from a known top bit and
+ * never touch the infinity point, independent of e.  (r ~ 0.45 * 2^256:
+ * e + r never carries out of 4 limbs, and the second add only happens
+ * when e + r < 2^255, which bounds e + 2r < 2^256.) */
+static void scalar_fix256(uint64_t out[4], const uint64_t e[4]) {
+    unsigned __int128 c = 0;
+    for (int i = 0; i < 4; i++) {
+        c += (unsigned __int128)e[i] + FB_ORDER[i];
+        out[i] = (uint64_t)c;
+        c >>= 64;
+    }
+    uint64_t mask = (uint64_t)0 - (1 ^ (out[3] >> 63));
+    c = 0;
+    for (int i = 0; i < 4; i++) {
+        c += (unsigned __int128)out[i] + (FB_ORDER[i] & mask);
+        out[i] = (uint64_t)c;
+        c >>= 64;
+    }
+}
+
+/* Scalar mult with a UNIFORM operation sequence: fixed-length ladder
+ * (scalar_fix256 pins the top bit), one double + one add + one branchless
+ * select per bit — unlike g2_mul above, no per-bit branch and no
+ * scalar-dependent iteration count, so the timing/branch trace does not
+ * encode the secret scalar.  Residual caveats, stated honestly: the
+ * exceptional-case branches inside g2_add (acc == +-p, i.e. a ladder
+ * prefix ~ +-1 mod r) fire with probability ~2^-254 for uniform secrets,
+ * and the Montgomery fp core is data-independent in operation sequence
+ * but not audited to asm level.  This is the double-and-always-add
+ * discipline production signers need; the sliding g2_mul stays for
+ * verification work on PUBLIC points where speed matters. */
+static void g2_mul_ct(g2_t *r, const g2_t *p, const uint64_t e[4]) {
+    uint64_t k[4];
+    g2_t acc, sum;
+    scalar_fix256(k, e);
+    acc = *p; /* top bit (255) is always set */
+    for (int i = 254; i >= 0; i--) {
+        g2_double(&acc, &acc);
+        g2_add(&sum, &acc, p);
+        g2_csel(&acc, &sum, &acc, (k[i >> 6] >> (i & 63)) & 1);
+    }
+    *r = acc;
+}
+
 static int g2_to_affine(fp2_t *x, fp2_t *y, const g2_t *p) {
     if (g2_is_infinity(p)) return 0;
     fp2_t zi, zi2, zi3;
@@ -1553,10 +1610,14 @@ static int scalar_from_be32(uint64_t e[4], const uint8_t *sk32) {
     return 0; /* == r */
 }
 
-/* BLS sign: sig = sk * hash_to_g2(msg), compressed out.  The blst
- * SecretKey.sign role (reference chain fixtures + validator signing,
- * @chainsafe/blst bindings) — lets dev chains and test suites skip the
- * pure-Python G2 ladder (~3 orders of magnitude slower). */
+/* BLS sign, VARIABLE TIME: sig = sk * hash_to_g2(msg), compressed out.
+ * The scalar mult is the sliding double-and-add g2_mul — its branch
+ * pattern and iteration count encode the secret key, so this path is for
+ * DEV/INTEROP USE ONLY (dev-chain fixtures, test suites, interop vectors
+ * — where the keys are the published interop secrets and speed is what
+ * matters; it skips the pure-Python G2 ladder, ~3 orders of magnitude
+ * slower).  Production validator signing goes through fb_sign_ct below;
+ * validator/store.py enforces the default. */
 int fb_sign(uint8_t *out_sig96, const uint8_t *sk32, const uint8_t *msg,
             size_t msg_len) {
     uint64_t e[4];
@@ -1564,6 +1625,22 @@ int fb_sign(uint8_t *out_sig96, const uint8_t *sk32, const uint8_t *msg,
     g2_t h, s;
     hash_to_g2(&h, msg, msg_len);
     g2_mul(&s, &h, e);
+    g2_to_compressed(out_sig96, &s);
+    return FB_OK;
+}
+
+/* BLS sign, constant-time-safe: identical bytes to fb_sign, but the
+ * scalar mult is the fixed-length double-and-always-add ladder
+ * (g2_mul_ct) — uniform operation sequence regardless of the key.  ~2x
+ * the cost of fb_sign (every bit pays the add), still ~500x the Python
+ * oracle.  The default signing path for ValidatorStore. */
+int fb_sign_ct(uint8_t *out_sig96, const uint8_t *sk32, const uint8_t *msg,
+               size_t msg_len) {
+    uint64_t e[4];
+    if (!scalar_from_be32(e, sk32)) return FB_MALFORMED;
+    g2_t h, s;
+    hash_to_g2(&h, msg, msg_len);
+    g2_mul_ct(&s, &h, e);
     g2_to_compressed(out_sig96, &s);
     return FB_OK;
 }
@@ -1704,5 +1781,19 @@ int fb_selftest(void) {
     /* subgroup checks accept the generators */
     if (!g1_subgroup_check(&g1)) return 0;
     if (!g2_subgroup_check(&g2)) return 0;
+    /* constant-time ladder == variable-time ladder (same compressed
+     * bytes for the same scalar), including a low-Hamming-weight scalar
+     * whose fixed-length handling is the part g2_mul skips */
+    {
+        uint8_t sk[32] = {0}, a[96], b[96];
+        sk[31] = 5;
+        if (fb_sign(a, sk, (const uint8_t *)"ct", 2) != FB_OK) return 0;
+        if (fb_sign_ct(b, sk, (const uint8_t *)"ct", 2) != FB_OK) return 0;
+        if (memcmp(a, b, 96) != 0) return 0;
+        sk[0] = 0x42;
+        if (fb_sign(a, sk, (const uint8_t *)"ct2", 3) != FB_OK) return 0;
+        if (fb_sign_ct(b, sk, (const uint8_t *)"ct2", 3) != FB_OK) return 0;
+        if (memcmp(a, b, 96) != 0) return 0;
+    }
     return 1;
 }

@@ -1,0 +1,67 @@
+"""First-party static analysis: the invariants that caused real regressions,
+machine-checked in tier-1.
+
+Every serious regression in this repo's history was an invariant violation
+no existing check could see before runtime:
+
+- BENCH_r05 rc=124: Mosaic rejected a mixed-width narrow-axis
+  ``tpu.concatenate`` the fused graph emitted through ``jnp.stack`` at
+  >16 lanes (fixed by ``fused_core.aligned_splice``, PR 1).
+- Blocking device syncs reachable from ``async def`` paths stall the
+  event loop for the whole dispatch latency.
+- Shared mutable state (verifier counters, the ``PointCache`` LRU)
+  mutated from ``asyncio.to_thread`` workers introduced in PR 3.
+
+Three layers, one report format (``report.Violation``):
+
+- ``jaxpr_audit``  — abstract-traces every public fused program in
+  ``lodestar_tpu/ops/`` (``jax.make_jaxpr`` only: no backend compile, no
+  device programs, so it runs inside the tier-1 conftest compile guard)
+  and asserts TPU-portability invariants on the IR.
+- ``ast_lint``     — pluggable AST checkers encoding the project's
+  async/tracing/locking discipline over the whole ``lodestar_tpu/`` tree.
+- ``lock_audit``   — instrumented lock wrappers + a deterministic
+  interleaving harness over the BLS hot path
+  (``BlsBatchPool._flush`` → ``TpuBlsVerifier.dispatch`` →
+  ``DeviceExecutor``) that flags unguarded shared-state mutation and
+  lock-order inversions at the first offending call, not by racing.
+
+``tools/lint.py`` drives all three and exits nonzero on violations;
+``bench.py`` runs the same suite as a pre-flight stage.  The rule
+catalogue (with the incident behind each rule and the inline-suppression
+syntax) is docs/static_analysis.md.
+"""
+
+from typing import List, Sequence
+
+from .report import Violation, format_report  # noqa: F401
+
+
+def run_all(
+    repo: str = None,
+    buckets: Sequence[int] = (4, 128),
+    with_jaxpr: bool = True,
+    with_lock_audit: bool = True,
+    trace_cache: bool = True,
+) -> List[Violation]:
+    """Every analysis layer, one violation list — the entry point
+    tools/lint.py, bench.py's pre-flight stage, and the tier-1 tests share
+    (lazy imports keep `import lodestar_tpu.analysis` jax-free)."""
+    import os
+
+    if repo is None:
+        repo = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+    from .ast_lint import run_ast_lint
+
+    violations = list(run_ast_lint(repo))
+    if with_lock_audit:
+        from .lock_audit import audit_bls_pipeline
+
+        violations += audit_bls_pipeline()
+    if with_jaxpr:
+        from .jaxpr_audit import audit_all
+
+        violations += audit_all(buckets=tuple(buckets), use_cache=trace_cache)
+    return violations

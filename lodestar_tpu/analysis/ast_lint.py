@@ -1,0 +1,332 @@
+"""Pluggable AST checkers encoding the project's hot-path discipline.
+
+Each checker is a small class with a stable ``rule`` id and a
+``check(path, tree, source)`` method returning ``Violation``s; the
+driver parses each file once and fans the tree out to every checker.
+Scope is the ``lodestar_tpu/`` package (the shipping tree — tests and
+tools lint themselves by running, not by rule).
+
+Rules (rationale + incident history in docs/static_analysis.md):
+
+- ``async-blocking-sync``   blocking device/future syncs lexically inside
+  ``async def`` (``.result()``, ``.block_until_ready()``, ``device_get``,
+  ``time.sleep``) — each one stalls the event loop for a whole dispatch
+  latency; route through ``asyncio.to_thread`` instead (passing the bound
+  method, e.g. ``to_thread(pending.result)``, is the sanctioned shape and
+  does not trip the rule: it is a reference, not a call).
+- ``tracing-wallclock``     ``time.time()`` in tracing code.  Spans from
+  different threads must share one monotonic clock
+  (``time.monotonic_ns``); wall clock steps under NTP and breaks span
+  ordering.  Fires anywhere under ``lodestar_tpu/tracing/`` and on any
+  ``time.time()`` nested inside a TRACER call's arguments elsewhere.
+- ``await-holding-lock``    ``await`` lexically inside a ``with`` block
+  whose context manager looks like a (threading) lock.  A thread lock
+  held across a suspension point blocks every other thread touching that
+  lock for the awaited duration — and deadlocks if the awaited task needs
+  the lock.
+- ``metrics-coverage``      every metric registered in
+  ``metrics/registry.py`` must be referenced by a dashboard or docs
+  (absorbed from tools/check_metrics_coverage.py).
+
+Suppression: ``# lint: disable=<rule>`` on the flagged line
+(report.suppressed_rules).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence
+
+from .report import Violation, filter_suppressed
+
+# ---------------------------------------------------------------------------
+# checker base + helpers
+# ---------------------------------------------------------------------------
+
+
+class Checker:
+    rule: str = "base"
+    description: str = ""
+
+    def check(self, path: str, tree: ast.AST, source: str) -> List[Violation]:
+        raise NotImplementedError
+
+
+def _terminal_name(node: ast.AST) -> Optional[str]:
+    """The rightmost identifier of a Name/Attribute chain (``a.b.c`` -> c)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_dotted(node: ast.AST, *parts: str) -> bool:
+    """True when ``node`` is exactly the dotted name parts (e.g. time.time)."""
+    for part in reversed(parts[1:]):
+        if not (isinstance(node, ast.Attribute) and node.attr == part):
+            return False
+        node = node.value
+    return isinstance(node, ast.Name) and node.id == parts[0]
+
+
+def _walk_skip_nested_defs(body: Sequence[ast.stmt]):
+    """Yield nodes in ``body`` without descending into nested function
+    definitions (their bodies run in their own execution context)."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.append(child)
+
+
+# ---------------------------------------------------------------------------
+# async-blocking-sync
+# ---------------------------------------------------------------------------
+
+_BLOCKING_ATTRS = {"result", "block_until_ready"}
+_BLOCKING_DOTTED = (("time", "sleep"), ("jax", "device_get"))
+_BLOCKING_NAMES = {"device_get"}
+
+
+class AsyncBlockingSyncChecker(Checker):
+    rule = "async-blocking-sync"
+    description = "blocking sync call lexically inside async def"
+
+    def check(self, path: str, tree: ast.AST, source: str) -> List[Violation]:
+        out: List[Violation] = []
+        for fn in ast.walk(tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for node in _walk_skip_nested_defs(fn.body):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                blocking = (
+                    (isinstance(f, ast.Attribute) and f.attr in _BLOCKING_ATTRS)
+                    or any(_is_dotted(f, *d) for d in _BLOCKING_DOTTED)
+                    or (isinstance(f, ast.Name) and f.id in _BLOCKING_NAMES)
+                )
+                if blocking:
+                    name = _terminal_name(f) or "<call>"
+                    out.append(
+                        Violation(
+                            self.rule, path, node.lineno,
+                            f"blocking call {name}() inside async def "
+                            f"{fn.name} — wrap in asyncio.to_thread "
+                            f"(pass the bound method, don't call it)",
+                        )
+                    )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# tracing-wallclock
+# ---------------------------------------------------------------------------
+
+
+def _is_tracer_call(call: ast.Call) -> bool:
+    """A call on the TRACER singleton (TRACER.add_span(...), tracer.instant
+    via any name ending in the tracer method set)."""
+    f = call.func
+    for sub in ast.walk(f):
+        if isinstance(sub, ast.Name) and sub.id == "TRACER":
+            return True
+    return isinstance(f, ast.Attribute) and f.attr in ("add_span", "instant")
+
+
+class TracingWallclockChecker(Checker):
+    rule = "tracing-wallclock"
+    description = "time.time() in tracing code (monotonic_ns only)"
+
+    def _flag(self, path, node, out, where):
+        out.append(
+            Violation(
+                self.rule, path, node.lineno,
+                f"time.time() {where} — tracing timestamps must be "
+                f"time.monotonic_ns() (one clock across threads, no NTP steps)",
+            )
+        )
+
+    def check(self, path: str, tree: ast.AST, source: str) -> List[Violation]:
+        out: List[Violation] = []
+        in_tracing_pkg = "tracing" in os.path.normpath(path).split(os.sep)
+        if in_tracing_pkg:
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Call) and _is_dotted(
+                    node.func, "time", "time"
+                ):
+                    self._flag(path, node, out, "in the tracing package")
+            return out
+        # elsewhere: flag time.time() nested in a TRACER call's arguments
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and _is_tracer_call(node)):
+                continue
+            for arg in node.args + [kw.value for kw in node.keywords]:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Call) and _is_dotted(
+                        sub.func, "time", "time"
+                    ):
+                        self._flag(path, sub, out, "feeding a TRACER span")
+        return out
+
+
+# ---------------------------------------------------------------------------
+# await-holding-lock
+# ---------------------------------------------------------------------------
+
+
+def _looks_like_lock(expr: ast.AST) -> bool:
+    name = _terminal_name(expr)
+    if name is None and isinstance(expr, ast.Call):
+        name = _terminal_name(expr.func)
+    return name is not None and "lock" in name.lower()
+
+
+class AwaitHoldingLockChecker(Checker):
+    rule = "await-holding-lock"
+    description = "await while holding a (threading) lock"
+
+    def check(self, path: str, tree: ast.AST, source: str) -> List[Violation]:
+        out: List[Violation] = []
+        for node in ast.walk(tree):
+            # sync `with` only: `async with` managers are asyncio locks,
+            # which are designed to be held across awaits
+            if not isinstance(node, ast.With):
+                continue
+            if not any(_looks_like_lock(i.context_expr) for i in node.items):
+                continue
+            for inner in _walk_skip_nested_defs(node.body):
+                if isinstance(inner, ast.Await):
+                    lock = next(
+                        _terminal_name(i.context_expr) or "<lock>"
+                        for i in node.items
+                        if _looks_like_lock(i.context_expr)
+                    )
+                    out.append(
+                        Violation(
+                            self.rule, path, inner.lineno,
+                            f"await while holding {lock} (acquired line "
+                            f"{node.lineno}) — a thread lock held across a "
+                            f"suspension point stalls every other thread",
+                        )
+                    )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# metrics-coverage (absorbed from tools/check_metrics_coverage.py)
+# ---------------------------------------------------------------------------
+
+
+class MetricsCoverageChecker(Checker):
+    """Repo-level checker: runs once (on registry.py) rather than per file."""
+
+    rule = "metrics-coverage"
+    description = "registered metric referenced by no dashboard and no doc"
+
+    def __init__(self, repo: str):
+        self.repo = repo
+
+    def check(self, path: str, tree: ast.AST, source: str) -> List[Violation]:
+        from . import metrics_coverage
+
+        report = metrics_coverage.check(self.repo)
+        out: List[Violation] = []
+        for metric, cov in report.items():
+            if cov["dashboards"] or cov["docs"]:
+                continue
+            line = 0
+            for i, text in enumerate(source.splitlines(), 1):
+                if metric in text:
+                    line = i
+                    break
+            out.append(
+                Violation(
+                    self.rule, path, line,
+                    f"metric {metric} appears in no dashboards/*.json and "
+                    f"no docs/*.md — add a panel or a docs table row",
+                )
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+DEFAULT_CHECKERS = (
+    AsyncBlockingSyncChecker,
+    TracingWallclockChecker,
+    AwaitHoldingLockChecker,
+)
+
+_REGISTRY_REL = os.path.join("lodestar_tpu", "metrics", "registry.py")
+
+
+def lint_source(
+    source: str, path: str, checkers: Optional[Sequence[Checker]] = None
+) -> List[Violation]:
+    """Run checkers over one in-memory source (fixtures, editors).  ``path``
+    is whatever the rules should scope on — it need not exist on disk."""
+    if checkers is None:
+        checkers = [c() for c in DEFAULT_CHECKERS]
+    tree = ast.parse(source, filename=path)
+    found: List[Violation] = []
+    for checker in checkers:
+        found.extend(checker.check(path, tree, source))
+    return filter_suppressed(found, {path: source})
+
+
+def iter_py_files(repo: str, rel_root: str = "lodestar_tpu"):
+    root = os.path.join(repo, rel_root)
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                full = os.path.join(dirpath, name)
+                yield os.path.relpath(full, repo)
+
+
+def run_ast_lint(
+    repo: str,
+    checkers: Optional[Sequence[Checker]] = None,
+    with_metrics: bool = True,
+) -> List[Violation]:
+    """Lint every .py file under ``lodestar_tpu/`` plus the repo-level
+    metrics-coverage rule.  Returns suppression-filtered violations."""
+    if checkers is None:
+        checkers = [c() for c in DEFAULT_CHECKERS]
+    sources: Dict[str, str] = {}
+    found: List[Violation] = []
+    for rel in iter_py_files(repo):
+        with open(os.path.join(repo, rel)) as f:
+            src = f.read()
+        sources[rel] = src
+        try:
+            tree = ast.parse(src, filename=rel)
+        except SyntaxError as e:
+            found.append(
+                Violation("syntax-error", rel, e.lineno or 0, str(e.msg))
+            )
+            continue
+        for checker in checkers:
+            found.extend(checker.check(rel, tree, src))
+    if with_metrics:
+        reg = os.path.join(repo, _REGISTRY_REL)
+        if os.path.exists(reg):
+            with open(reg) as f:
+                reg_src = f.read()
+            sources[_REGISTRY_REL] = reg_src
+            found.extend(
+                MetricsCoverageChecker(repo).check(
+                    _REGISTRY_REL, ast.parse(reg_src), reg_src
+                )
+            )
+    return filter_suppressed(found, sources)

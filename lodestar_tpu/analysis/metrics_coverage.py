@@ -1,0 +1,47 @@
+"""Metrics-coverage core: which registered metrics are visible to operators.
+
+Absorbed from tools/check_metrics_coverage.py (which now delegates here) so
+the rule runs as a first-class checker in the lint suite
+(ast_lint.MetricsCoverageChecker) while the standalone CLI keeps working.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List
+
+# r.counter("name", ...) / r.gauge(...) / r.histogram(...) in registry.py;
+# \s* spans the newline argparse-style call wrapping produces
+_METRIC_RE = re.compile(r"r\.(?:counter|gauge|histogram)\(\s*\"([^\"]+)\"")
+
+
+def registered_metrics(repo: str) -> List[str]:
+    path = os.path.join(repo, "lodestar_tpu", "metrics", "registry.py")
+    with open(path) as f:
+        return _METRIC_RE.findall(f.read())
+
+
+def _corpus(repo: str, subdir: str, exts: tuple) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    root = os.path.join(repo, subdir)
+    if not os.path.isdir(root):
+        return out
+    for name in sorted(os.listdir(root)):
+        if name.endswith(exts):
+            with open(os.path.join(root, name)) as f:
+                out[os.path.join(subdir, name)] = f.read()
+    return out
+
+
+def check(repo: str) -> Dict[str, Dict[str, List[str]]]:
+    """Per-metric coverage: which dashboards and docs mention it."""
+    dashboards = _corpus(repo, "dashboards", (".json",))
+    docs = _corpus(repo, "docs", (".md",))
+    report: Dict[str, Dict[str, List[str]]] = {}
+    for metric in registered_metrics(repo):
+        report[metric] = {
+            "dashboards": [p for p, text in dashboards.items() if metric in text],
+            "docs": [p for p, text in docs.items() if metric in text],
+        }
+    return report

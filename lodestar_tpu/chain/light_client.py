@@ -249,8 +249,10 @@ class LightClientServer:
             finalized_header=finalized_header or empty_header,
             finality_branch=finality_branch,
             sync_aggregate=sync_aggregate,
+            # spec LightClientUpdate field; clients derive the signing
+            # domain from their own fork schedule at this slot (an
+            # update-supplied fork version is never trusted)
             signature_slot=signature_slot or (attested_block.message.slot + 1),
-            fork_version=bytes(attested_state.fork.current_version),
         )
 
     def get_update(self, period: int):

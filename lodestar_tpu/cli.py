@@ -200,6 +200,11 @@ def build_parser() -> argparse.ArgumentParser:
     vc.add_argument("--gas-limit", type=int, default=30_000_000)
     vc.add_argument("--builder", action="store_true",
                     help="prefer blinded (MEV builder) block production")
+    vc.add_argument("--dev-signing", action="store_true",
+                    help="DEV/INTEROP ONLY: use the variable-time native "
+                    "signing ladder (fb_sign) instead of the default "
+                    "constant-time-safe path — its timing leaks the key, "
+                    "acceptable only for published interop secrets")
 
     init_cmd = sub.add_parser("init", help="persist flag values to an rc file (cmds/init)")
     common(init_cmd)
@@ -593,7 +598,8 @@ async def run_validator(args) -> int:
                 logger.warning("remote key 0x%s... not yet active", pk.hex()[:12])
         logger.info("remote signer: %d keys from %s", len(remote_keys), args.remote_signer_url)
     store = ValidatorStore(preset, cfg, keys, protection, genesis_validators_root=gvr,
-                           remote_signer=remote_signer, remote_keys=remote_keys)
+                           remote_signer=remote_signer, remote_keys=remote_keys,
+                           dev_signing=getattr(args, "dev_signing", False))
     fee_recipient = _hex_bytes(
         getattr(args, "fee_recipient", "0x" + "00" * 20), 20, "--fee-recipient"
     )

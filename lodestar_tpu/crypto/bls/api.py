@@ -61,11 +61,23 @@ class SecretKey:
             return PublicKey(raw=raw)
         return PublicKey(G1_GEN * self.value)
 
-    def sign(self, msg: bytes) -> "Signature":
-        # native path (fb_sign): identical compressed bytes, ~3 orders of
-        # magnitude faster than the bigint G2 ladder; differential test
-        # pins byte equality (tests/test_native_sign.py)
-        raw = _native.sign(self.to_bytes(), msg)
+    def sign(self, msg: bytes, variable_time: bool = False) -> "Signature":
+        """sk * H(msg), native-first (identical compressed bytes to the
+        bigint ladder, ~3 orders of magnitude faster; differential tests
+        pin byte equality AND fb_selftest pins ct == variable-time).
+
+        Default is the CONSTANT-TIME-SAFE native ladder (fb_sign_ct:
+        fixed-length double-and-always-add, uniform operation sequence) —
+        the variable-time sliding ladder (fb_sign) leaks the secret
+        scalar through its branch pattern and is opt-in for dev/interop
+        fixtures where the keys are the published interop secrets
+        (``variable_time=True``; ValidatorStore gates this via
+        ``dev_signing``).  The pure-Python fallback (no native lib) is a
+        plain double-and-add bigint ladder: correct, slow, and NOT
+        constant-time — acceptable only because it is the no-toolchain
+        degradation path."""
+        sk = self.to_bytes()
+        raw = _native.sign(sk, msg) if variable_time else _native.sign_ct(sk, msg)
         if raw is not None:
             return Signature(raw=raw)
         return Signature(hash_to_g2(msg) * self.value)
@@ -153,11 +165,15 @@ class Signature:
 def sign_aggregate(sks: Sequence[SecretKey], msg: bytes) -> "Signature":
     """Aggregate signature of the same message by many keys — one hash +
     one scalar mult on the native path (fb_sign_aggregate); per-key sign +
-    aggregate otherwise.  The whole-committee signing shape."""
+    aggregate otherwise.  The whole-committee signing shape of DEV CHAINS
+    and sim fixtures only (interop keys): the underlying scalar mult is
+    the variable-time ladder, which is fine exactly because these keys
+    are public test vectors — production per-validator signing goes
+    through ValidatorStore (constant-time path)."""
     raw = _native.sign_aggregate([sk.to_bytes() for sk in sks], msg)
     if raw is not None:
         return Signature(raw=raw)
-    return aggregate_signatures([sk.sign(msg) for sk in sks])
+    return aggregate_signatures([sk.sign(msg, variable_time=True) for sk in sks])
 
 
 def aggregate_pubkeys(pubkeys: Sequence[PublicKey]) -> PublicKey:

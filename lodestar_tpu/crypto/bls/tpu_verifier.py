@@ -255,6 +255,12 @@ class TpuBlsVerifier:
         self._sched_lock = threading.Lock()
         self._rr = 0  # round-robin tie-break cursor
         self.point_cache = PointCache(point_cache_size)
+        # stats lock: the counters below are mutated from asyncio.to_thread
+        # pack/result workers AND the warmup daemon thread concurrently
+        # (the PR-3 race surface the lock audit pins) — every write goes
+        # through this leaf lock (never held across another lock or any
+        # device work)
+        self._stats_lock = threading.Lock()
         # pool-style counters (metrics parity with blsThreadPool.*,
         # metrics/metrics/lodestar.ts:385)
         self.dispatches = 0
@@ -402,12 +408,14 @@ class TpuBlsVerifier:
                     if self.fused:
                         logger.warning("degrading to XLA-graph kernels (fused=False)")
                         self.fused = False
-                        self.fused_fallbacks += 1
+                        with self._stats_lock:
+                            self.fused_fallbacks += 1
                         for e2 in self._executors:
                             e2.compiled.pop(key, None)
                         return self.warmup(buckets) + (time.perf_counter() - t0)
         dt = time.perf_counter() - t0
-        self.stage_seconds["warmup"] += dt
+        with self._stats_lock:
+            self.stage_seconds["warmup"] += dt
         if TRACER.enabled:
             TRACER.instant("bls.warmup_done", cat="bls", seconds=round(dt, 3),
                            devices=self.n_devices)
@@ -431,7 +439,8 @@ class TpuBlsVerifier:
         try:
             if not bool(ok):
                 return False
-            self.host_final_exps += 1
+            with self._stats_lock:
+                self.host_final_exps += 1
             f = np.asarray(f_digits, dtype=np.float64)  # (6, 2, 50)
             comps = []
             for i in range(6):
@@ -454,7 +463,8 @@ class TpuBlsVerifier:
             return final_exponentiation(fq12).is_one()
         finally:
             dt = time.perf_counter() - t0
-            self.stage_seconds["final_exp"] += dt
+            with self._stats_lock:
+                self.stage_seconds["final_exp"] += dt
             if self.metrics:
                 self.metrics.bls_pool_final_exp_seconds.observe(dt)
             if TRACER.enabled:
@@ -512,8 +522,9 @@ class TpuBlsVerifier:
         A compile failure on the fused path (Mosaic lowering) degrades
         this verifier to the XLA-graph kernels and retries once — a bad
         kernel must not take block import down with it."""
-        self.dispatches += 1
-        self.sets_verified += int(np.sum(np.asarray(packed[6])))
+        with self._stats_lock:
+            self.dispatches += 1
+            self.sets_verified += int(np.sum(np.asarray(packed[6])))
         n = packed[0].shape[0]
         t0_ns = TRACER.now()
         # snapshot the path THIS call uses: a concurrent warmup_async thread
@@ -529,7 +540,8 @@ class TpuBlsVerifier:
                     raise
                 logger.warning("fused dispatch failed (%s); degrading to XLA kernels", e)
                 self.fused = False
-                self.fused_fallbacks += 1
+                with self._stats_lock:
+                    self.fused_fallbacks += 1
                 out = self._fn(n, fused=False, executor=ex)(*packed)
         except Exception:
             self._release_executor(ex)
@@ -561,7 +573,8 @@ class TpuBlsVerifier:
         only the rejection counter moves — padding and the pack histogram
         count successful packs exclusively (a rejected batch never
         dispatches, so its padding was never 'wasted' on a device)."""
-        self.pack_rejected += 1
+        with self._stats_lock:
+            self.pack_rejected += 1
         if self.metrics:
             self.metrics.bls_pack_rejected_total.inc()
         return None
@@ -675,15 +688,17 @@ class TpuBlsVerifier:
             mask = np.zeros(b, dtype=bool)
             mask[:n] = True
             # padding counts only for batches that will actually dispatch
-            self.padding_wasted += b - n
+            with self._stats_lock:
+                self.padding_wasted += b - n
             if self.metrics:
                 self.metrics.bls_pool_pack_seconds.observe(time.perf_counter() - t0)
             return (pk_x, pk_y, sig_x, sig_y, msg_u, bits, mask)
         finally:
             dt = time.perf_counter() - t0
-            self.stage_seconds["pack"] += dt
-            self.pack_cache_hits += hits
-            self.pack_cache_misses += misses
+            with self._stats_lock:
+                self.stage_seconds["pack"] += dt
+                self.pack_cache_hits += hits
+                self.pack_cache_misses += misses
             if self.metrics:
                 if hits:
                     self.metrics.bls_pack_cache_hits_total.inc(hits)

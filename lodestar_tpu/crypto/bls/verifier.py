@@ -97,7 +97,10 @@ class PointCache:
 
     def get(self, key: bytes) -> Optional[Tuple[int, ...]]:
         if self.maxsize <= 0:
-            self.misses += 1
+            # counters are shared across to_thread pack workers too — the
+            # disabled path takes the same lock (it is uncontended here)
+            with self._lock:
+                self.misses += 1
             return None
         with self._lock:
             val = self._data.get(key)

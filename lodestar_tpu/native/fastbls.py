@@ -74,6 +74,10 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.fb_sign.argtypes = [
                 ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
             ]
+            lib.fb_sign_ct.restype = ctypes.c_int
+            lib.fb_sign_ct.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+            ]
             lib.fb_sk_to_pk.restype = ctypes.c_int
             lib.fb_sk_to_pk.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
             lib.fb_sign_aggregate.restype = ctypes.c_int
@@ -136,13 +140,30 @@ def final_exp_is_one(f_bytes: bytes) -> Optional[bool]:
 
 
 def sign(sk32: bytes, msg: bytes) -> Optional[bytes]:
-    """sk * H(msg) as a compressed 96-byte G2 signature (fb_sign); None
-    without the native lib or for an invalid scalar."""
+    """sk * H(msg) as a compressed 96-byte G2 signature — VARIABLE TIME
+    (fb_sign, sliding double-and-add: the branch pattern encodes the
+    secret key).  Dev/interop fixtures only; production signing uses
+    ``sign_ct``.  None without the native lib or for an invalid scalar."""
     lib = _load()
     if lib is None or len(sk32) != 32:
         return None
     out = ctypes.create_string_buffer(96)
     if lib.fb_sign(out, sk32, msg, len(msg)) != 1:
+        return None
+    return out.raw
+
+
+def sign_ct(sk32: bytes, msg: bytes) -> Optional[bytes]:
+    """Constant-time-safe signing (fb_sign_ct): identical bytes to
+    ``sign`` via a fixed-length double-and-always-add ladder — uniform
+    operation sequence regardless of the key, ~2x the variable-time
+    cost (measured; every bit pays the add).  The ValidatorStore default.  None without the native lib or
+    for an invalid scalar."""
+    lib = _load()
+    if lib is None or len(sk32) != 32:
+        return None
+    out = ctypes.create_string_buffer(96)
+    if lib.fb_sign_ct(out, sk32, msg, len(msg)) != 1:
         return None
     return out.raw
 

@@ -72,10 +72,15 @@ class DevChain:
 
     # -- inline validator duties (validator/src/services analogs) -------------
 
+    # dev-chain signatures come from the PUBLISHED interop keys, so the
+    # variable-time native ladder is safe here and keeps fixture
+    # generation at full speed (the explicit dev/interop opt-in —
+    # production signing in validator/store.py defaults constant-time)
+
     def _sign_randao(self, state, proposer: int, epoch: int) -> bytes:
         domain = get_domain(self.p, state, DOMAIN_RANDAO, epoch)
         root = compute_signing_root(self.p, uint64, epoch, domain)
-        return self.keys[proposer].sign(root).to_bytes()
+        return self.keys[proposer].sign(root, variable_time=True).to_bytes()
 
     def _sign_block(self, state, block, proposer: int) -> bytes:
         from ..state_transition.upgrade import block_types
@@ -89,7 +94,7 @@ class DevChain:
             else t.BeaconBlock
         )
         root = compute_signing_root(self.p, block_type, block, domain)
-        return self.keys[proposer].sign(root).to_bytes()
+        return self.keys[proposer].sign(root, variable_time=True).to_bytes()
 
     def _sign_sync_aggregate(self, pre):
         """Full-participation sync aggregate over the previous block root

@@ -391,7 +391,15 @@ def _altair(p: Preset, ph: ForkTypes) -> ForkTypes:
         ],
     )
     # light client (altair sync-committee protocol,
-    # packages/types/src/altair/sszTypes.ts LightClientUpdate)
+    # packages/types/src/altair/sszTypes.ts LightClientUpdate).  The spec
+    # container ends with signature_slot — the slot whose committee/domain
+    # signed the aggregate; validation and is_better_update ranking both
+    # key off it, so an SSZ round-trip must carry it (a container without
+    # it silently drops the field and the client falls back to guessing
+    # attested.slot + 1).  The outdated altair-draft fork_version field is
+    # gone: the client derives the domain from ITS OWN fork schedule at
+    # the signature slot — trusting an update-supplied version would let a
+    # malicious server pick the domain (light_client/client.py).
     t.LightClientUpdate = Container(
         "LightClientUpdate",
         [
@@ -401,7 +409,7 @@ def _altair(p: Preset, ph: ForkTypes) -> ForkTypes:
             ("finalized_header", ph.BeaconBlockHeader),
             ("finality_branch", Vector(Bytes32, 6)),
             ("sync_aggregate", t.SyncAggregate),
-            ("fork_version", Version),
+            ("signature_slot", Slot),
         ],
     )
     return t

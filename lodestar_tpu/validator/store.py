@@ -41,10 +41,19 @@ class ValidatorStore:
         genesis_validators_root: bytes = b"\x00" * 32,
         remote_signer=None,
         remote_keys: Optional[Dict[int, bytes]] = None,
+        dev_signing: bool = False,
     ):
         self.p = preset
         self.cfg = cfg
         self.keys = keys
+        # Signing-path discipline: production signing uses the
+        # constant-time-safe native ladder (fb_sign_ct — uniform operation
+        # sequence, no key-dependent branching).  ``dev_signing=True`` is
+        # the explicit dev/interop opt-in for the variable-time
+        # double-and-add path (fb_sign): ~2x faster, and its timing
+        # leaks the scalar — acceptable ONLY for published interop keys
+        # (dev chains, sim fixtures, spec-vector generation).
+        self.dev_signing = dev_signing
         self.t = get_types(preset).phase0
         self.gvr = genesis_validators_root
         self.protection = slashing_protection or SlashingProtection(genesis_validators_root)
@@ -59,7 +68,7 @@ class ValidatorStore:
     def _sign(self, validator_index: int, root: bytes) -> bytes:
         sk = self.keys.get(validator_index)
         if sk is not None:
-            return sk.sign(root).to_bytes()
+            return sk.sign(root, variable_time=self.dev_signing).to_bytes()
         if self.remote_signer is None:
             raise KeyError(f"no signer for validator {validator_index}")
         return self.remote_signer.sign(self.pubkeys[validator_index], root)

@@ -1,0 +1,29 @@
+"""Known-bad fixtures for the static-analysis suite.
+
+One fixture per lint rule; tests/test_static_analysis.py asserts each
+checker fires EXACTLY on the lines marked ``# VIOLATION`` in its fixture
+and nowhere in the live ``lodestar_tpu/`` tree.  The AST fixtures are
+parsed, never imported (they reference undefined names on purpose);
+``bad_jaxpr_programs`` is the importable exception — its programs are
+traced by the jaxpr-auditor fixture tests.
+"""
+
+import os
+
+
+def fixture_path(name: str) -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+
+
+def fixture_source(name: str) -> str:
+    with open(fixture_path(name)) as f:
+        return f.read()
+
+
+def violation_lines(source: str) -> list:
+    """1-based line numbers carrying a ``# VIOLATION`` marker."""
+    return [
+        i
+        for i, line in enumerate(source.splitlines(), 1)
+        if "# VIOLATION" in line
+    ]

@@ -1,0 +1,37 @@
+"""Known-bad fixture: blocking syncs lexically inside ``async def``.
+
+Every marked line stalls the event loop for a whole device-dispatch
+latency (the regression class PR 1's pipelined dispatch exists to avoid).
+Parsed by tests/test_static_analysis.py, never imported.
+"""
+
+import asyncio
+import time
+
+
+async def drain_batch(pending, arr, y):
+    ok = pending.result()  # VIOLATION: concurrent-future sync on the loop
+    arr.block_until_ready()  # VIOLATION: device sync on the loop
+    time.sleep(0.1)  # VIOLATION: wall-clock stall on the loop
+    host = jax.device_get(y)  # VIOLATION: device->host readback on the loop
+    return ok, host
+
+
+async def sanctioned_shapes(pending, sets, verifier):
+    # the sanctioned pattern: hand the BOUND METHOD to a worker thread
+    ok = await asyncio.to_thread(pending.result)
+    # plain awaits and non-blocking attribute access never trip the rule
+    merged = await asyncio.to_thread(verifier.verify_signature_sets, sets)
+    fut = asyncio.get_running_loop().create_future()
+    fut.set_result(ok)  # set_result is not result()
+    return merged
+
+
+async def suppressed(pending):
+    # inline opt-out for the rare justified case (docs/static_analysis.md)
+    return pending.result()  # lint: disable=async-blocking-sync
+
+
+def sync_context(pending):
+    # outside async def the same calls are fine: result() IS the sync point
+    return pending.result()

@@ -1,0 +1,27 @@
+"""Known-bad fixture: ``await`` while holding a threading lock.
+
+A thread lock held across a suspension point blocks every other thread
+needing that lock for the awaited duration — and deadlocks outright when
+the awaited task itself needs the lock (the shape the PR-3 to_thread
+workers make reachable).  Parsed by tests/test_static_analysis.py, never
+imported.
+"""
+
+import asyncio
+
+
+class Pool:
+    async def flush_holding_lock(self):
+        with self._sched_lock:
+            verdict = await self.queue.get()  # VIOLATION
+        return verdict
+
+    async def sanctioned(self):
+        # compute under the lock, await OUTSIDE it
+        with self._sched_lock:
+            batch = list(self._items)
+        ok = await asyncio.to_thread(self.verifier.verify_signature_sets, batch)
+        # asyncio locks are designed to be held across awaits
+        async with self._aio_lock:
+            await self.emit(ok)
+        return ok

@@ -1,0 +1,26 @@
+"""Known-bad fixture: wall-clock timestamps feeding TRACER spans.
+
+Spans recorded on different threads must share ONE monotonic clock;
+``time.time()`` steps under NTP and breaks span ordering/merging.  Parsed
+by tests/test_static_analysis.py, never imported.  The tracing-package
+variant of the rule is exercised by linting THIS file again under a
+pretend ``lodestar_tpu/tracing/`` path (where every ``time.time()`` call
+fires, not just TRACER-nested ones).
+"""
+
+import time
+
+
+def record_span(cid):
+    TRACER.add_span("bls.pack", "bls", int(time.time() * 1e9), cid=cid)  # VIOLATION
+
+
+def record_instant():
+    TRACER.instant("clock.slot", ts=time.time())  # VIOLATION
+
+
+def fine_outside_tracer():
+    # wall clock for non-span purposes is allowed outside lodestar_tpu/tracing/
+    started_at = time.time()  # PKG-VIOLATION: fires only under tracing/
+    TRACER.add_span("ok.span", "ok", TRACER.now())
+    return started_at

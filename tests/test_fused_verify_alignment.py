@@ -28,6 +28,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from lodestar_tpu.analysis import jaxpr_audit
 from lodestar_tpu.ops import batch_verify as bv
 from lodestar_tpu.ops import limbs as fl
 from lodestar_tpu.ops.fused_core import LV, aligned_splice, lconcat
@@ -78,120 +79,61 @@ class TestAlignedSplice:
 # ---------------------------------------------------------------------------
 
 
-import functools
+# The trace machinery (abstract batch args, recursive eqn walk, the
+# narrow-mixed-concat predicate) moved to lodestar_tpu.analysis.jaxpr_audit
+# where tools/lint.py and tests/test_static_analysis.py drive it too.
+# These tests consume the auditor's per-(entry, bucket) ARTIFACTS —
+# in-process lru-cached AND persisted under .jax_cache/ keyed by a content
+# hash of lodestar_tpu/ops/ — so a trace of the full fused graph (the
+# expensive part, ~15-30s) is paid once per ops/ edit, not once per run.
 
-
-def _abstract_batch(n):
-    S = jax.ShapeDtypeStruct
-    return (
-        S((n, fl.NLIMBS), jnp.float32),
-        S((n, fl.NLIMBS), jnp.float32),
-        S((n, 2, fl.NLIMBS), jnp.float32),
-        S((n, 2, fl.NLIMBS), jnp.float32),
-        S((n, 2, 2, fl.NLIMBS), jnp.float32),
-        S((n, 64), jnp.float32),
-        S((n,), jnp.bool_),
-    )
-
-
-def _walk_eqns(jaxpr, out):
-    for eqn in jaxpr.eqns:
-        out.append(eqn)
-        for v in eqn.params.values():
-            if hasattr(v, "eqns"):
-                _walk_eqns(v, out)
-            elif hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):
-                _walk_eqns(v.jaxpr, out)
-            elif isinstance(v, (list, tuple)):
-                for item in v:
-                    if hasattr(item, "eqns"):
-                        _walk_eqns(item, out)
-                    elif hasattr(item, "jaxpr") and hasattr(item.jaxpr, "eqns"):
-                        _walk_eqns(item.jaxpr, out)
-
-
-def _split_entry(*a):
-    f, ok = miller_product_fused(*a, interpret=True)
-    return f.a, ok  # digits + verdict (the static bound is not an output)
-
-
-_ENTRIES = {
-    "split": _split_entry,
-    "full": lambda *a: verify_signature_sets_fused(*a, interpret=True),
+_ENTRY_NAMES = {
+    "split": "fused_verify.miller_product_fused",
+    "full": "fused_verify.verify_signature_sets_fused",
 }
 
 
-@functools.lru_cache(maxsize=None)
-def _traced(bucket, entry_name):
-    """One trace per (bucket, entry) shared by the concat and shape tests
-    — tracing the full fused graph is the expensive part."""
-    return jax.make_jaxpr(_ENTRIES[entry_name])(*_abstract_batch(bucket))
+def _mixed_concats(bucket, entry_name):
+    art = jaxpr_audit.entry_artifacts(_ENTRY_NAMES[entry_name], bucket)
+    return art["mixed_concats"]
 
 
-def _narrow_mixed_concats(jaxpr):
-    """Concatenate eqns that mix operand extents along the concat dim while
-    every tiled non-concat dim (the trailing two, Mosaic's vreg tile) is
-    below (8, 128) — the shape class Mosaic cannot retile."""
-    eqns = []
-    _walk_eqns(jaxpr.jaxpr, eqns)
-    bad = []
-    for eqn in eqns:
-        if eqn.primitive.name != "concatenate":
-            continue
-        d = eqn.params["dimension"]
-        shapes = [v.aval.shape for v in eqn.invars]
-        extents = {s[d] for s in shapes}
-        if len(extents) == 1:
-            continue  # uniform splice, retileable
-        rank = len(shapes[0])
-        tiled = [(ax, tile) for ax, tile in ((rank - 2, 8), (rank - 1, 128))
-                 if 0 <= ax != d]
-        if tiled and all(
-            s[ax] < tile for s in shapes for ax, tile in tiled
-        ):
-            bad.append((d, shapes))
-    return bad
-
-
-# coverage note: full@128 is omitted — its batch-dependent subgraph is
-# identical to split@128 and its batch-independent tail (final exp +
-# is_one, batch shape ()) is covered by full@4; each trace costs ~30s of
-# tier-1 wall time, so redundant combinations are skipped deliberately
+# coverage note: split@4, full@4, split@128, full@128 are exactly the
+# auditor's AUDIT_BUCKETS matrix, so every combination here rides the
+# shared cache
 @pytest.mark.parametrize(
     "bucket,entry", [(4, "split"), (4, "full"), (128, "split")]
 )
 def test_fused_graph_has_no_narrow_mixed_concat(bucket, entry):
-    bad = _narrow_mixed_concats(_traced(bucket, entry))
+    bad = _mixed_concats(bucket, entry)
     assert not bad, f"narrow mixed-width concatenates remain: {bad}"
 
 
-@functools.lru_cache(maxsize=None)
 def _xla_split_avals():
     # the XLA kernel's outputs are batch-independent ((6,2,50) digits +
     # scalar verdict), so ONE trace at bucket 4 is the oracle for every
-    # bucket — tracing it per-bucket would only re-spend tier-1 seconds
-    return jax.eval_shape(bv.miller_product_kernel, *_abstract_batch(4))
+    # bucket; it comes from the shared auditor cache (the jaxpr audit
+    # traces the same entry)
+    return jaxpr_audit.entry_out_avals("batch_verify.miller_product_kernel", 4)
 
 
 @pytest.mark.parametrize("bucket", [4, 128])
 def test_fused_shapes_match_xla_kernel(bucket):
     """Interpret-mode shape equivalence vs the XLA-graph kernels: the
     fused twins must be drop-in for TpuBlsVerifier's packing code."""
-    got = _traced(bucket, "split").out_avals
+    got = jaxpr_audit.entry_out_avals(_ENTRY_NAMES["split"], bucket)
     want = _xla_split_avals()
-    assert got[0].shape == want[0].shape == (6, 2, fl.NLIMBS)
-    assert got[1].shape == want[1].shape == ()
-    assert got[1].dtype == want[1].dtype
+    assert got[0][0] == want[0][0] == (6, 2, fl.NLIMBS)
+    assert got[1][0] == want[1][0] == ()
+    assert got[1][1] == want[1][1]
 
 
 def test_fused_full_verdict_shape_matches_xla_kernel():
     # the XLA twin's output is a static scalar bool
     # (batch_verify.verify_signature_sets_kernel docstring) — asserting
     # against the literal avoids a second whole-graph XLA trace
-    got_full = _traced(4, "full").out_avals
-    assert len(got_full) == 1
-    assert got_full[0].shape == ()
-    assert got_full[0].dtype == jnp.bool_
+    got_full = jaxpr_audit.entry_out_avals(_ENTRY_NAMES["full"], 4)
+    assert got_full == [((), "bool")]
 
 
 # ---------------------------------------------------------------------------
@@ -221,7 +163,7 @@ def test_fused_vs_xla_miller_product_value_bucket4():
     jax.default_backend() != "tpu", reason="Mosaic lowering needs a real TPU"
 )
 def test_fused_program_compiles_on_tpu():
-    args = _abstract_batch(4)
+    args = jaxpr_audit._abstract_batch(4)
 
     def kernel(*a):
         f, ok = miller_product_fused(*a, interpret=False)

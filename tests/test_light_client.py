@@ -180,3 +180,59 @@ def test_light_client_two_period_gap_and_forced_advance():
         pool.close()
 
     asyncio.run(main())
+
+
+def test_light_client_update_ssz_roundtrip_keeps_signature_slot():
+    """The spec LightClientUpdate container must carry signature_slot
+    through an SSZ round-trip (ADVICE finding: the outdated altair-draft
+    layout carried fork_version instead, so serializing a server-built
+    update silently DROPPED signature_slot and the client fell back to
+    guessing attested.slot + 1 — wrong for any update whose aggregate was
+    signed later than the next slot)."""
+    t = get_types(MINIMAL).altair
+    typ = t.LightClientUpdate
+    names = [name for name, _ in typ.fields]
+    assert "signature_slot" in names, "spec field missing from the container"
+    assert "fork_version" not in names, (
+        "updates must not transport a fork version — clients derive the "
+        "domain from their own fork schedule at signature_slot"
+    )
+
+    header = Fields(
+        slot=97, proposer_index=3, parent_root=b"\x11" * 32,
+        state_root=b"\x22" * 32, body_root=b"\x33" * 32,
+    )
+    committee = Fields(
+        pubkeys=[bytes([i]) * 48 for i in range(MINIMAL.SYNC_COMMITTEE_SIZE)],
+        aggregate_pubkey=b"\xaa" * 48,
+    )
+    update = Fields(
+        attested_header=header,
+        next_sync_committee=committee,
+        next_sync_committee_branch=[bytes([i]) * 32 for i in range(5)],
+        finalized_header=Fields(
+            slot=64, proposer_index=1, parent_root=b"\x44" * 32,
+            state_root=b"\x55" * 32, body_root=b"\x66" * 32,
+        ),
+        finality_branch=[bytes([10 + i]) * 32 for i in range(6)],
+        sync_aggregate=Fields(
+            sync_committee_bits=[i % 2 == 0 for i in range(MINIMAL.SYNC_COMMITTEE_SIZE)],
+            sync_committee_signature=b"\x77" * 96,
+        ),
+        # deliberately NOT attested.slot + 1: the round-trip must carry the
+        # real value, not something the fallback guess could reproduce
+        signature_slot=103,
+    )
+    back = typ.deserialize(typ.serialize(update))
+    assert int(back.signature_slot) == 103
+    assert back.attested_header.slot == 97
+    assert bytes(back.sync_aggregate.sync_committee_signature) == b"\x77" * 96
+    # ranking/validation consume the round-tripped value directly (no
+    # attested.slot+1 fallback for SSZ-transported updates)
+    lc_sig_slot = LightClient.__dict__["_signature_slot"]
+
+    class _Stub:
+        pass
+
+    stub = _Stub()
+    assert lc_sig_slot(stub, back) == 103

@@ -202,3 +202,72 @@ def test_lazy_signature_roundtrip_and_equality():
     pk_lazy = sk.to_public_key()
     pk_eager = PublicKey(C.G1_GEN * sk.value)
     assert pk_lazy == pk_eager and not pk_lazy.is_infinity()
+
+
+class TestConstantTimeSigning:
+    """fb_sign_ct: the production signing path (fixed-length
+    double-and-always-add ladder) must produce byte-identical signatures
+    to both the variable-time native ladder and the Python oracle, and
+    ValidatorStore must default to it (dev_signing is the explicit
+    variable-time opt-in)."""
+
+    def test_ct_matches_variable_time_and_oracle(self):
+        from lodestar_tpu.crypto.bls.api import SecretKey
+        from lodestar_tpu.crypto.bls.hash_to_curve import hash_to_g2
+        from lodestar_tpu.crypto.bls import curve as C
+        from lodestar_tpu.native import fastbls
+
+        if not fastbls.have_native():
+            import pytest
+            pytest.skip("native lib unavailable")
+        for i, msg in ((1, b"a"), (7, b"ct-msg"), (0x1234, b"\x00" * 32)):
+            sk = SecretKey(i * 0x9E3779B97F4A7C15 + 1)
+            ct = fastbls.sign_ct(sk.to_bytes(), msg)
+            vt = fastbls.sign(sk.to_bytes(), msg)
+            assert ct == vt, "ct ladder diverged from variable-time ladder"
+            oracle = C.g2_to_bytes(hash_to_g2(msg) * sk.value)
+            assert ct == oracle, "native signatures diverged from the oracle"
+
+    def test_secret_key_sign_defaults_constant_time(self, monkeypatch):
+        from lodestar_tpu.crypto.bls.api import SecretKey
+        from lodestar_tpu.native import fastbls
+
+        calls = []
+        monkeypatch.setattr(
+            fastbls, "sign_ct",
+            lambda sk, m: calls.append("ct") or fastbls.sign(sk, m),
+        )
+        real_vt = fastbls.sign
+        monkeypatch.setattr(
+            fastbls, "sign", lambda sk, m: calls.append("vt") or real_vt(sk, m)
+        )
+        sk = SecretKey(12345)
+        sk.sign(b"default-path")
+        assert calls[0] == "ct", "SecretKey.sign default must be constant-time"
+        calls.clear()
+        sk.sign(b"dev-path", variable_time=True)
+        assert calls[0] == "vt"
+
+    def test_validator_store_gates_variable_time(self, monkeypatch):
+        from lodestar_tpu.crypto.bls import api as bls_api
+        from lodestar_tpu.config.chain_config import ChainConfig
+        from lodestar_tpu.params import MINIMAL
+        from lodestar_tpu.validator.store import ValidatorStore
+
+        seen = []
+        orig = bls_api.SecretKey.sign
+
+        def spy(self, msg, variable_time=False):
+            seen.append(variable_time)
+            return orig(self, msg, variable_time=variable_time)
+
+        monkeypatch.setattr(bls_api.SecretKey, "sign", spy)
+        keys = {0: bls_api.interop_secret_key(0)}
+        cfg = ChainConfig(PRESET_BASE="minimal")
+        store = ValidatorStore(MINIMAL, cfg, keys)
+        store.sign_randao(0, 1)
+        assert seen == [False], "production store must sign constant-time"
+        seen.clear()
+        dev_store = ValidatorStore(MINIMAL, cfg, keys, dev_signing=True)
+        dev_store.sign_randao(0, 1)
+        assert seen == [True], "dev_signing=True must opt into fb_sign"

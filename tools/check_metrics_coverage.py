@@ -8,6 +8,10 @@ promise to the operator; this gate forces every new registry entry to
 land with either a dashboard panel or a docs/observability.md table row
 (usually both).  Runnable standalone and from tests/test_tracing.py.
 
+The coverage logic lives in lodestar_tpu.analysis.metrics_coverage (it is
+also the lint suite's ``metrics-coverage`` rule — tools/lint.py); this
+script is the thin standalone CLI.
+
 Usage:
     python tools/check_metrics_coverage.py [--repo PATH] [--list]
 
@@ -18,49 +22,21 @@ from __future__ import annotations
 
 import argparse
 import os
-import re
 import sys
-from typing import Dict, List
+from typing import List
 
-# r.counter("name", ...) / r.gauge(...) / r.histogram(...) in registry.py;
-# \s* spans the newline argparse-style call wrapping produces
-_METRIC_RE = re.compile(r"r\.(?:counter|gauge|histogram)\(\s*\"([^\"]+)\"")
+_REPO_DEFAULT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_DEFAULT)
 
-
-def registered_metrics(repo: str) -> List[str]:
-    path = os.path.join(repo, "lodestar_tpu", "metrics", "registry.py")
-    with open(path) as f:
-        return _METRIC_RE.findall(f.read())
-
-
-def _corpus(repo: str, subdir: str, exts: tuple) -> Dict[str, str]:
-    out: Dict[str, str] = {}
-    root = os.path.join(repo, subdir)
-    if not os.path.isdir(root):
-        return out
-    for name in sorted(os.listdir(root)):
-        if name.endswith(exts):
-            with open(os.path.join(root, name)) as f:
-                out[os.path.join(subdir, name)] = f.read()
-    return out
-
-
-def check(repo: str) -> Dict[str, Dict[str, List[str]]]:
-    """Per-metric coverage: which dashboards and docs mention it."""
-    dashboards = _corpus(repo, "dashboards", (".json",))
-    docs = _corpus(repo, "docs", (".md",))
-    report: Dict[str, Dict[str, List[str]]] = {}
-    for metric in registered_metrics(repo):
-        report[metric] = {
-            "dashboards": [p for p, text in dashboards.items() if metric in text],
-            "docs": [p for p, text in docs.items() if metric in text],
-        }
-    return report
+from lodestar_tpu.analysis.metrics_coverage import (  # noqa: E402
+    check,
+    registered_metrics,  # noqa: F401  (re-export for existing importers)
+)
 
 
 def main(argv: List[str] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--repo", default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    ap.add_argument("--repo", default=_REPO_DEFAULT)
     ap.add_argument("--list", action="store_true", help="print full coverage table")
     args = ap.parse_args(argv)
     report = check(args.repo)

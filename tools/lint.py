@@ -1,0 +1,96 @@
+#!/usr/bin/env python3
+"""Invariant lint driver: run every static-analysis layer, exit nonzero on
+violations.
+
+Layers (see lodestar_tpu/analysis/ and docs/static_analysis.md):
+
+1. AST lint over lodestar_tpu/ (async hot-path discipline, tracing
+   clock discipline, lock-hold discipline, metrics coverage).
+2. Lock/race audit: instrumented-lock interleaving harness over
+   BlsBatchPool._flush -> TpuBlsVerifier.dispatch -> DeviceExecutor.
+3. Jaxpr auditor: abstract traces of every public fused entry point in
+   lodestar_tpu/ops/ at two bucket sizes (make_jaxpr only — CPU-safe, no
+   device programs; ~2 min cold, then incremental: per-entry artifacts
+   are cached under .jax_cache/ keyed by a content hash of ops/, so
+   re-runs on an untouched ops/ replay in milliseconds).
+
+Usage:
+    python tools/lint.py [--repo PATH] [--json] [--skip-jaxpr]
+                         [--skip-lock-audit] [--buckets 4,128] [--rules]
+
+Exit 0 when clean; exit 1 listing the violations.  tier-1 drives the same
+layers from tests/test_static_analysis.py; bench.py runs this as a
+pre-flight stage and records violations in extras.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+_REPO_DEFAULT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_DEFAULT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # tracing never needs a TPU
+
+from lodestar_tpu.analysis import format_report, run_all  # noqa: E402,F401
+from lodestar_tpu.analysis.report import to_dicts  # noqa: E402
+
+
+def _print_rules() -> None:
+    from lodestar_tpu.analysis.ast_lint import DEFAULT_CHECKERS, MetricsCoverageChecker
+
+    rows = [(c.rule, c.description) for c in DEFAULT_CHECKERS]
+    rows.append((MetricsCoverageChecker.rule, MetricsCoverageChecker.description))
+    rows += [
+        ("lock-unguarded-mutation", "shared hot-path state mutated without its lock"),
+        ("lock-order-inversion", "cycle in the lock acquisition graph"),
+        ("jaxpr-narrow-mixed-concat", "Mosaic-unretileable splice (BENCH_r05 class)"),
+        ("jaxpr-f64-leak", "64-bit dtype outside the f32 limb format"),
+        ("jaxpr-host-callback", "host callback inside a hot-path program"),
+        ("jaxpr-unstable-cache-key", "captured scalar / bucket-dependent constants"),
+    ]
+    width = max(len(r) for r, _ in rows)
+    for rule, desc in rows:
+        print(f"{rule:<{width}}  {desc}")
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--repo", default=_REPO_DEFAULT)
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument("--skip-jaxpr", action="store_true",
+                    help="skip the (slow) jaxpr IR audit")
+    ap.add_argument("--skip-lock-audit", action="store_true",
+                    help="skip the lock/race interleaving harness")
+    ap.add_argument("--buckets", default="4,128",
+                    help="comma-separated bucket sizes for the jaxpr audit")
+    ap.add_argument("--no-trace-cache", action="store_true",
+                    help="ignore the .jax_cache/ artifact cache and re-trace "
+                    "every entry point (the cache self-invalidates on any "
+                    "ops/ edit; this flag forces it)")
+    ap.add_argument("--rules", action="store_true", help="list the rule catalogue")
+    args = ap.parse_args(argv)
+    if args.rules:
+        _print_rules()
+        return 0
+    buckets = tuple(int(b) for b in args.buckets.split(",") if b)
+    violations = run_all(
+        repo=args.repo,
+        buckets=buckets,
+        with_jaxpr=not args.skip_jaxpr,
+        with_lock_audit=not args.skip_lock_audit,
+        trace_cache=not args.no_trace_cache,
+    )
+    if args.json:
+        print(json.dumps({"violations": to_dicts(violations)}, indent=2))
+    else:
+        print(format_report(violations))
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
