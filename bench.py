@@ -39,6 +39,13 @@ from lodestar_tpu.crypto.bls.tpu_verifier import (  # noqa: E402
 
 configure_persistent_cache(os.path.join(_REPO, ".jax_cache"))
 
+# Stage-child salvage (round 9): pin the scratch dir in the environment
+# BEFORE any child spawns so parent and children agree on where heartbeat
+# bundles land — the parent reads the last one back on a stage timeout.
+from lodestar_tpu.forensics import salvage  # noqa: E402
+
+os.environ.setdefault(salvage.BASE_DIR_ENV, salvage.base_dir())
+
 BATCH = int(os.environ.get("BENCH_BATCH", "128"))
 
 
@@ -530,9 +537,22 @@ def _dump_stage_trace(stage: str):
         return None
 
 
+def bench_wedge(seconds: float = 3600.0):
+    """Fault-injection stage (tests only): wedge until the parent's
+    timeout kills us — the BENCH_r05 failure shape on demand.  The
+    heartbeat must leave a salvageable bundle behind."""
+    time.sleep(seconds)
+
+
 def _stage_child(q, fn_name, args):
     """Subprocess entry: run one benchmark stage and ship the result (or
-    the error repr) back over the queue."""
+    the error repr) back over the queue.  A salvage heartbeat snapshots
+    this child's journal/trace/in-flight state to the scratch dir so a
+    timeout kill still leaves evidence (the rc=124 fix)."""
+    try:
+        hb = salvage.start_heartbeat(fn_name)
+    except Exception:  # scratch-disk trouble must not fail the stage
+        hb = None
     try:
         fn = globals()[fn_name]
         q.put(("ok", fn(*args)))
@@ -541,6 +561,9 @@ def _stage_child(q, fn_name, args):
             q.put(("err", f"{type(e).__name__}: {e}"))
         except Exception:  # unpicklable payloads must not hang the parent
             q.put(("err", type(e).__name__))
+    finally:
+        if hb is not None:
+            hb.stop()
 
 
 def _stage(fn_name, args=(), timeout_s=600.0, retries=1):
@@ -569,8 +592,15 @@ def _stage(fn_name, args=(), timeout_s=600.0, retries=1):
                 # device init ("Device or resource busy")
                 p.kill()
                 p.join(10)
-            last_err = f"timeout after {timeout_s:.0f}s"
-            print(f"{fn_name}: {last_err}", file=sys.stderr)
+            # salvage: attach THIS child's last heartbeat bundle (pid-
+            # scoped — a child killed before its first beat must not be
+            # blamed on a previous run's leftovers) so the timeout is a
+            # diagnosable artifact, not just a wall-clock number
+            last_err = {
+                "error": f"timeout after {timeout_s:.0f}s",
+                "bundle": salvage.latest_stage_bundle(fn_name, pid=p.pid),
+            }
+            print(f"{fn_name}: {last_err['error']}", file=sys.stderr)
             continue
         p.join(30)
         if status == "ok":
@@ -683,6 +713,9 @@ def main() -> None:
                         "violations": lint_violations,
                         "count": len(lint_violations) if lint_violations is not None else None,
                     },
+                    # where stage children heartbeat their salvage bundles
+                    # (a timed-out stage's last-known state lives here)
+                    "forensics_dir": os.environ.get(salvage.BASE_DIR_ENV),
                     "stage_errors": errors or None,
                     "backend": jax.default_backend(),
                 },

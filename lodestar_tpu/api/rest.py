@@ -29,6 +29,10 @@ api/impl/beacon/ (genesis/headers/blocks/pool).  Routes implemented:
   GET  /eth/v1/beacon/light_client/bootstrap/{block_root}
   GET  /eth/v1/beacon/light_client/updates?start_period=&count=
   GET  /metrics  (prometheus text exposition when a registry is wired)
+  GET  /eth/v1/lodestar/traces      (span-tracer dump; ?format=chrome)
+  GET  /eth/v1/lodestar/bls_stages  (BLS pipeline counters)
+  GET  /eth/v1/lodestar/health      (aggregated operational health)
+  GET  /eth/v1/lodestar/forensics   (on-demand diagnostic bundle)
 """
 
 from __future__ import annotations
@@ -173,8 +177,10 @@ class RestApiServer:
                     payload = fn(match.groupdict(), query, json.loads(body) if body else None)
                     if asyncio.iscoroutine(payload):
                         payload = await payload
-                    if isinstance(payload, tuple):  # (bytes, content-type)
-                        return 200, payload[0], payload[1]
+                    if isinstance(payload, tuple):
+                        if len(payload) == 3:  # (status, payload, content-type)
+                            return payload
+                        return 200, payload[0], payload[1]  # (bytes, content-type)
                     return 200, payload, "application/json"
                 except ApiError as e:
                     return e.status, {"code": e.status, "message": e.message}, "application/json"
@@ -192,7 +198,7 @@ class RestApiServer:
 
     def _register_routes(self) -> None:
         r = self._route
-        r("GET", "/eth/v1/node/health", lambda pp, q, b: {})
+        r("GET", "/eth/v1/node/health", self._health)
         r("GET", "/eth/v1/node/version", lambda pp, q, b: {"data": {"version": VERSION}})
         r("GET", "/eth/v1/node/syncing", self._syncing)
         # node/peers + identity (routes/node.ts getPeers/getPeerCount)
@@ -250,6 +256,9 @@ class RestApiServer:
         # the hot-path span timeline and the BLS stage split
         r("GET", "/eth/v1/lodestar/traces", self._traces)
         r("GET", "/eth/v1/lodestar/bls_stages", self._bls_stages)
+        # failure forensics: aggregated node health + on-demand bundle dump
+        r("GET", "/eth/v1/lodestar/health", self._lodestar_health)
+        r("GET", "/eth/v1/lodestar/forensics", self._forensics)
 
     # -- node/peers + config namespaces ----------------------------------------
 
@@ -529,6 +538,18 @@ class RestApiServer:
         blk = self._block_for(pp["block_id"])
         enc, _dec = _fork_tagged_block_codec(self.p)
         return enc(blk), "application/octet-stream"
+
+    def _health(self, pp, q, b):
+        """Spec getHealth (routes/node.ts): 200 ready, 206 synced-but-
+        syncing, 503 not ready.  Body is empty per spec — the status code
+        IS the answer."""
+        try:
+            syncing = self._syncing(pp, q, b)["data"]["is_syncing"]
+        except Exception:  # noqa: BLE001 — no head state yet: not ready
+            return (503, {}, "application/json")
+        if syncing:
+            return (206, {}, "application/json")
+        return {}
 
     def _syncing(self, pp, q, b):
         head_slot = self.chain.head_state().slot
@@ -1037,3 +1058,59 @@ class RestApiServer:
             "pack_rejected": getattr(verifier, "pack_rejected", 0),
         }
         return {"data": data}
+
+    def _lodestar_health(self, pp, q, b):
+        """Aggregated operational health, built on the spec health status:
+        pool depth, per-device in-flight, watchdog state, and the last
+        journal error — one curl answers 'is this node okay and if not,
+        what broke last'."""
+        from ..forensics import INFLIGHT, JOURNAL, RECORDER
+
+        health = self._health(pp, q, b)
+        status = health[0] if isinstance(health, tuple) else 200
+        pool = getattr(self.chain, "bls", None) if self.chain is not None else None
+        verifier = getattr(pool, "verifier", None)
+        wd = RECORDER.watchdog
+        data = {
+            "status": status,
+            "pending_sets": (
+                pool.pending_sets()
+                if pool is not None and hasattr(pool, "pending_sets") else 0
+            ),
+            "inflight": INFLIGHT.snapshot(),
+            "device_inflight": (
+                verifier.device_inflight()
+                if hasattr(verifier, "device_inflight") else {}
+            ),
+            "watchdog": wd.state() if wd is not None else None,
+            "journal": {
+                "events": len(JOURNAL),
+                "dropped": JOURNAL.dropped,
+                "last_error": JOURNAL.last_error(),
+            },
+            "bundles_written": RECORDER.bundles_written,
+        }
+        return (status, {"data": data}, "application/json")
+
+    def _forensics(self, pp, q, b):
+        """On-demand diagnostic bundle ('what are you doing right now'
+        without sending SIGUSR2).  Writes a bundle and returns its path
+        plus the manifest, so `curl .../forensics | jq .data.manifest`
+        is a remote triage in one call."""
+        import os
+
+        from ..forensics import RECORDER
+        from ..forensics.bundle import MANIFEST_NAME
+
+        pool = getattr(self.chain, "bls", None) if self.chain is not None else None
+        RECORDER.configure(metrics=self.metrics, pool=pool)
+        # caller text is slugged + bounded (directory name) and NEVER the
+        # metric label (unbounded cardinality from a query string); the
+        # recorder also prunes its dir, so polling cannot fill the disk
+        raw = q.get("reason", "")
+        slug = "".join(c for c in raw if c.isalnum() or c in "-_")[:32]
+        path = RECORDER.dump(f"api-{slug}" if slug else "api",
+                             metric_reason="api")
+        with open(os.path.join(path, MANIFEST_NAME)) as f:
+            manifest = json.load(f)
+        return {"data": {"bundle": path, "manifest": manifest}}

@@ -42,6 +42,7 @@ from typing import List, Optional, Sequence
 
 from .. import tracing
 from ..crypto.bls.verifier import IBlsVerifier, SignatureSet
+from ..forensics.journal import JOURNAL
 from ..tracing import TRACER
 from ..utils.queue import JobItemQueue, QueueType
 from ..utils.logger import get_logger
@@ -190,6 +191,14 @@ class BlsBatchPool:
                     if self.metrics:
                         self.metrics.bls_pool_dispatches_total.inc()
                         self.metrics.bls_pool_batch_size.observe(len(merged))
+                    # black box: the coalescing decision (how many jobs
+                    # merged into this batch, window occupancy at the time)
+                    if JOURNAL.enabled:
+                        JOURNAL.record(
+                            "pool.flush", cid=cid, jobs=len(jobs),
+                            sets=len(merged), inflight=len(inflight),
+                            window=window,
+                        )
                     # correlation id rides the contextvar into to_thread and
                     # create_task (both copy the current context), so the
                     # verifier's pack/dispatch/final-exp spans pick it up
@@ -301,3 +310,7 @@ class BlsBatchPool:
         if stage_seconds:
             for stage, secs in stage_seconds.items():
                 self.metrics.bls_verifier_stage_seconds.labels(stage=stage).set(secs)
+        # drop visibility: ring-buffer evictions would otherwise be the
+        # one thing the observability stack is silent about
+        self.metrics.tracing_spans_dropped_total.set(TRACER.dropped)
+        self.metrics.forensics_journal_dropped_total.set(JOURNAL.dropped)

@@ -148,6 +148,27 @@ def build_parser() -> argparse.ArgumentParser:
             "write the device profile to DIR — the XLA-level view the "
             "span tracer sits above",
         )
+        p.add_argument(
+            "--forensics-dir", default=None, metavar="DIR",
+            help="diagnostic bundle directory (default: "
+            "$LODESTAR_TPU_FORENSICS_DIR or <tmp>/lodestar-tpu-forensics); "
+            "bundles are written on crash, SIGTERM/SIGUSR2, watchdog "
+            "stall, and GET /eth/v1/lodestar/forensics "
+            "(docs/observability.md §Failure forensics)",
+        )
+        p.add_argument(
+            "--watchdog-deadline-s", type=float, default=30.0,
+            help="flag any dispatched BLS batch still unresolved after "
+            "this many seconds: journal ERROR + "
+            "bls_watchdog_stalls_total{device} + one automatic bundle "
+            "(0 disables the watchdog)",
+        )
+        p.add_argument(
+            "--log-format", choices=("text", "json"), default=None,
+            help="stderr log line format; json emits one machine-"
+            "ingestable object per line stamped with the batch "
+            "correlation id (default: text, or $LODESTAR_LOG_FORMAT)",
+        )
 
     dev = sub.add_parser("dev", help="single-process interop chain (cmds/dev)")
     common(dev)
@@ -248,6 +269,7 @@ async def run_dev(args) -> int:
     # observe the new pipeline-stage histograms in dev mode too
     metrics = create_metrics() if args.metrics else None
     pool = _make_pool(args, metrics=metrics)
+    _configure_forensics(args, metrics=metrics, pool=pool)
     controller = SqliteDbController(args.db) if args.db else MemoryDbController()
     db = BeaconDb(preset, controller)
     dev = DevChain(preset, cfg, args.validators, pool, db=db)
@@ -294,6 +316,27 @@ def _configure_tracing(args) -> None:
         tracing.enable(getattr(args, "trace_buffer_size", 8192))
         logger.info("span tracing on (buffer %d); dump -> %s",
                     tracing.TRACER.capacity, dump)
+
+
+def _configure_forensics(args, metrics=None, pool=None) -> None:
+    """Flight-recorder bring-up (docs/observability.md §Failure
+    forensics): log format, bundle directory, crash/signal hooks,
+    faulthandler, and the in-flight stall watchdog."""
+    from .forensics import RECORDER
+    from .utils.logger import set_format
+
+    fmt = getattr(args, "log_format", None)
+    if fmt:
+        set_format(fmt)
+    RECORDER.configure(
+        forensics_dir=getattr(args, "forensics_dir", None),
+        metrics=metrics, pool=pool,
+    )
+    deadline = getattr(args, "watchdog_deadline_s", 30.0)
+    RECORDER.install(watchdog_deadline_s=deadline if deadline > 0 else None)
+    logger.info("flight recorder on: bundles -> %s (watchdog %s)",
+                RECORDER.dir,
+                f"{deadline:.1f}s" if deadline > 0 else "off")
 
 
 def _dump_trace(path) -> None:
@@ -433,6 +476,7 @@ async def run_beacon(args) -> int:
 
     metrics = create_metrics()
     pool = _make_pool(args, metrics=metrics)
+    _configure_forensics(args, metrics=metrics, pool=pool)
     execution_engine = None
     if args.execution_url:
         from urllib.parse import urlparse as _urlparse

@@ -44,6 +44,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from ...forensics.journal import JOURNAL, install_jax_monitoring
+from ...forensics.watchdog import INFLIGHT
 from ...ops import batch_verify as bv
 from ...ops import htc
 from ...ops import limbs as fl
@@ -99,6 +101,10 @@ def configure_persistent_cache(
 
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", min_compile_secs)
+        # flight recorder: compile/cache-load durations land in the
+        # always-on journal, so a wedged/cold compile is visible in any
+        # diagnostic bundle (the evidence BENCH_r05 died without)
+        install_jax_monitoring(JOURNAL)
         _CACHE_CONFIGURED = True
     return cache_dir
 
@@ -407,6 +413,10 @@ class TpuBlsVerifier:
                     )
                     if self.fused:
                         logger.warning("degrading to XLA-graph kernels (fused=False)")
+                        JOURNAL.record(
+                            "bls.degrade", level="WARNING", where="warmup",
+                            bucket=b, device=ex.name, error=str(e)[:300],
+                        )
                         self.fused = False
                         with self._stats_lock:
                             self.fused_fallbacks += 1
@@ -419,6 +429,8 @@ class TpuBlsVerifier:
         if TRACER.enabled:
             TRACER.instant("bls.warmup_done", cat="bls", seconds=round(dt, 3),
                            devices=self.n_devices)
+        JOURNAL.record("bls.warmup", seconds=round(dt, 3),
+                       devices=self.n_devices, fused=self.fused)
         return dt
 
     def warmup_async(self, buckets: Optional[Sequence[int]] = None) -> threading.Thread:
@@ -522,9 +534,10 @@ class TpuBlsVerifier:
         A compile failure on the fused path (Mosaic lowering) degrades
         this verifier to the XLA-graph kernels and retries once — a bad
         kernel must not take block import down with it."""
+        live = int(np.sum(np.asarray(packed[6])))
         with self._stats_lock:
             self.dispatches += 1
-            self.sets_verified += int(np.sum(np.asarray(packed[6])))
+            self.sets_verified += live
         n = packed[0].shape[0]
         t0_ns = TRACER.now()
         # snapshot the path THIS call uses: a concurrent warmup_async thread
@@ -539,6 +552,10 @@ class TpuBlsVerifier:
                 if not used_fused:
                     raise
                 logger.warning("fused dispatch failed (%s); degrading to XLA kernels", e)
+                JOURNAL.record(
+                    "bls.degrade", level="WARNING", where="dispatch",
+                    bucket=n, device=ex.name, error=str(e)[:300],
+                )
                 self.fused = False
                 with self._stats_lock:
                     self.fused_fallbacks += 1
@@ -546,15 +563,29 @@ class TpuBlsVerifier:
         except Exception:
             self._release_executor(ex)
             raise
+        cid = current_batch_id()
         if TRACER.enabled:
             # covers the async enqueue only (plus compile when cold); the
             # device compute itself surfaces as the gap before final_exp.
             # device/devices_total let tools/check_trace.py assert a
             # multi-device dump actually spread across the pool
             TRACER.add_span("bls.dispatch", "bls", t0_ns,
-                            cid=current_batch_id(), bucket=n, fused=used_fused,
+                            cid=cid, bucket=n, fused=used_fused,
                             device=ex.name, devices_total=self.n_devices)
-        release = lambda: self._release_executor(ex)  # noqa: E731
+        # flight recorder: placement decision into the black box, the
+        # batch into the in-flight table the watchdog scans — resolved by
+        # the same exactly-once path that returns the executor slot, so a
+        # verdict that never syncs leaves a stall-shaped entry behind
+        if JOURNAL.enabled:
+            JOURNAL.record("bls.dispatch", cid=cid, device=ex.name, bucket=n,
+                           sets=live, fused=used_fused,
+                           inflight=ex.inflight, devices_total=self.n_devices)
+        token = INFLIGHT.register(cid=cid, device=ex.name, bucket=n, sets=live)
+
+        def release():
+            INFLIGHT.resolve(token)
+            self._release_executor(ex)
+
         if self.host_final_exp:
             f, ok = out
             return PendingVerdict(verifier=self, f=f, ok=ok, release=release,

@@ -172,6 +172,29 @@ class Metrics:
             "pack-stage rejections (malformed bytes or infinity point; "
             "the batch never dispatched)",
         )
+        # flight recorder & failure forensics (round 9)
+        self.bls_watchdog_stalls_total = r.counter(
+            "lodestar_bls_watchdog_stalls_total",
+            "dispatched batches flagged by the watchdog as unresolved past "
+            "the deadline (a silent device wedge made visible)",
+            labels=("device",),
+        )
+        self.tracing_spans_dropped_total = r.gauge(
+            "lodestar_tracing_spans_dropped_total",
+            "spans evicted from the tracer ring buffer (history a trace "
+            "dump is missing)",
+        )
+        self.forensics_journal_dropped_total = r.gauge(
+            "lodestar_forensics_journal_dropped_total",
+            "events evicted from the forensics journal ring (history a "
+            "diagnostic bundle is missing)",
+        )
+        self.forensics_bundles_written_total = r.counter(
+            "lodestar_forensics_bundles_written_total",
+            "diagnostic bundles written, by trigger reason "
+            "(watchdog/sigterm/sigusr2/crash-*/api)",
+            labels=("reason",),
+        )
         # chain
         self.block_processing_seconds = r.histogram(
             "lodestar_block_processing_seconds",

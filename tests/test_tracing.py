@@ -139,9 +139,22 @@ class TestChromeExport:
         errs = check_trace.validate(
             {"traceEvents": [{"ph": "X", "name": "a", "ts": 1},  # no dur
                              {"name": "b"},  # no ph
-                             {"ph": "i", "name": "c", "ts": 0, "pid": "x"}]}
+                             {"ph": "i", "name": "c", "ts": 0, "pid": "x"}],
+             "otherData": {"dropped_spans": 0}}
         )
         assert len(errs) == 3
+
+    def test_validator_requires_drop_count_note(self):
+        """Round 9: an object-form dump must say how many spans the ring
+        evicted under it (otherData.dropped_spans) — a dump that cannot
+        quantify its missing history is silently lying about coverage."""
+        errs = check_trace.validate({"traceEvents": []})
+        assert any("dropped_spans" in e for e in errs)
+        assert check_trace.validate(
+            {"traceEvents": [], "otherData": {"dropped_spans": 7}}
+        ) == []
+        # bare list-form dumps (no wrapper object) carry no note to check
+        assert check_trace.validate([]) == []
 
     def test_pipeline_requirement(self):
         tr = SpanTracer()

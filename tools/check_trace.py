@@ -12,7 +12,10 @@ and Perfetto actually require to load a file):
 - ``X``/``B``/``E``/``i``/``I`` events carry a numeric ``ts``;
 - complete events (``ph == "X"``) carry a numeric non-negative ``dur``;
 - ``pid``/``tid``, when present, are integers;
-- ``args``, when present, is an object.
+- ``args``, when present, is an object;
+- object-form dumps note their drop count
+  (``otherData.dropped_spans``) — a dump that cannot say how much
+  history the ring evicted under it is silently lying about coverage.
 
 ``--require-pipeline [N]`` additionally asserts the dump contains the
 full BLS span taxonomy — ``bls.queue_wait`` / ``bls.pack`` /
@@ -45,6 +48,13 @@ def validate(trace: Any) -> List[str]:
         events = trace.get("traceEvents")
         if not isinstance(events, list):
             return ["top-level object has no traceEvents list"]
+        if not isinstance(
+            (trace.get("otherData") or {}).get("dropped_spans"), int
+        ):
+            errors.append(
+                "otherData.dropped_spans missing: the dump must note how "
+                "many spans the ring evicted"
+            )
     elif isinstance(trace, list):
         events = trace
     else:
