@@ -515,6 +515,120 @@ def bench_multichip(time_budget_s: float = 420.0):
     }
 
 
+def bench_firehose(time_budget_s: float = 300.0):
+    """Sustained-load stage (ISSUE 6): drive tools/firehose.run_firehose
+    against a REAL BlsBatchPool on the deterministic stub verifier (zero
+    XLA work — the pool's scheduling, shedding, and backpressure are the
+    system under test, not the kernel) and publish:
+
+    - ``sustained_sets_per_s_at_slo``: the highest offered rate on a
+      x1.5 ladder whose p99 queue-wait stays under the SLO with zero
+      drops — the number a capacity planner needs;
+    - an induced overload run at 2x that rate: bounded queue memory,
+      zero stranded futures, block-proposal-lane p99, every drop
+      accounted in the dropped_total{reason,lane} analog, and the
+      shed-rate-triggered "overload" diagnostic bundle validated by
+      tools/inspect_bundle.py.
+
+    The stage rides the PR 5 salvage path like every other stage (a
+    wedged run leaves heartbeat bundles) and runs the forensics watchdog
+    so a stall inside the window produces its own bundle."""
+    import asyncio
+    import tempfile
+
+    from lodestar_tpu import tracing
+    from lodestar_tpu.chain.bls_pool import BlsBatchPool
+    from lodestar_tpu.forensics.bundle import latest_bundle
+    from lodestar_tpu.forensics.recorder import RECORDER
+    from tools.firehose import StubVerifier, run_firehose
+    from tools.inspect_bundle import summarize as bundle_summarize
+    from tools.inspect_bundle import validate as bundle_validate
+
+    slo_ms = float(os.environ.get("BENCH_FIREHOSE_SLO_MS", 100.0))
+    window_s = float(os.environ.get("BENCH_FIREHOSE_WINDOW_S", 3.0))
+    t_start = time.perf_counter()
+
+    def fresh_pool(**kw):
+        tracing.TRACER.clear()
+        tracing.enable(65536)
+        # overload bundles default OFF: ladder rungs that shed must not
+        # dump through the (not yet configured) global recorder — only
+        # the induced-overload run below opts in, after RECORDER.configure
+        kw.setdefault("overload_shed_threshold", 0)
+        return BlsBatchPool(StubVerifier(), max_buffer_wait=0.01,
+                            flush_threshold=128, pipeline_depth=2, **kw)
+
+    def run(pool, **kw):
+        async def _go():
+            try:
+                return await run_firehose(pool, **kw)
+            finally:
+                pool.close()
+
+        return asyncio.run(_go())
+
+    # -- SLO ladder: find the sustained rate ---------------------------------
+    rate, sustained = 1000.0, None
+    while time.perf_counter() - t_start < time_budget_s * 0.6:
+        report = run(fresh_pool(), rate=rate, duration_s=window_s,
+                     deadline_ms=1000.0)
+        ok = (
+            report["stranded_futures"] == 0
+            and report["dropped_sets_total"] == 0
+            and report["intake_shed_total"] == 0
+            and (report["queue_wait"]["p99_ms"] or 0) <= slo_ms
+            and report["achieved_sets_per_s"] >= 0.9 * rate
+        )
+        if not ok:
+            break
+        sustained = report
+        rate *= 1.5
+    if sustained is None:
+        return {"error": "no rate met the SLO", "slo_p99_queue_wait_ms": slo_ms}
+    sustained_rate = sustained["offered_rate_sets_per_s"]
+
+    # -- induced overload: offered = 2x sustained ----------------------------
+    forensics_dir = tempfile.mkdtemp(prefix="firehose-forensics-")
+    pool = fresh_pool(max_queue_length=4096,
+                      overload_shed_threshold=128, overload_cooldown_s=5.0)
+    RECORDER.configure(forensics_dir=forensics_dir, pool=pool)
+    RECORDER.start_watchdog(deadline_s=20.0)
+    try:
+        overload = run(pool, rate=2.0 * sustained_rate,
+                       duration_s=window_s * 2, deadline_ms=400.0)
+    finally:
+        RECORDER.stop_watchdog()
+    bundle = latest_bundle(forensics_dir)
+    bundle_errors = bundle_valid = bundle_overload = None
+    if bundle:
+        errs = bundle_validate(bundle)
+        bundle_valid = not errs
+        bundle_errors = errs or None
+        bundle_overload = bundle_summarize(bundle).get("overload")
+
+    def slim(r):
+        return {
+            k: r[k] for k in (
+                "offered_rate_sets_per_s", "achieved_sets_per_s",
+                "queue_wait", "e2e", "block_lane_p99_ms", "dropped_sets",
+                "intake_shed_total", "unaccounted_sets", "stranded_futures",
+                "pending_sets_after", "outcomes",
+            )
+        }
+
+    return {
+        "slo_p99_queue_wait_ms": slo_ms,
+        "window_s": window_s,
+        "sustained_sets_per_s_at_slo": sustained_rate,
+        "sustained": slim(sustained),
+        "overload": slim(overload),
+        "overload_bundle": bundle,
+        "overload_bundle_valid": bundle_valid,
+        "overload_bundle_errors": bundle_errors,
+        "overload_bundle_summary": bundle_overload,
+    }
+
+
 def _enable_stage_trace() -> None:
     """Span-trace the e2e stages (ISSUE 2): each emits a Chrome-trace
     artifact whose path rides in the stage's extras."""
@@ -676,6 +790,12 @@ def main() -> None:
     scale, err = _stage("bench_scale_250k", (), 420)
     if err:
         errors["scale_250k"] = err
+    # sustained-load survival (ISSUE 6): SLO-bounded sustained rate plus an
+    # induced-overload run with full drop accounting and a validated
+    # overload bundle — stub verifier, so no device contention here
+    firehose, err = _stage("bench_firehose", (), 420)
+    if err:
+        errors["firehose"] = err
     import jax
 
     baseline = cpu_native if cpu_native else cpu_oracle
@@ -709,6 +829,7 @@ def main() -> None:
                     "range_sync_trace": range_res.get("trace_path"),
                     "multichip": multichip,
                     "scale_250k": scale,
+                    "firehose": firehose,
                     "lint": {
                         "violations": lint_violations,
                         "count": len(lint_violations) if lint_violations is not None else None,

@@ -15,7 +15,7 @@ Mechanics kept from the reference, retuned for a TPU dispatch:
 - a failed merged batch is retried per job so one bad gossip message
   cannot poison its batchmates (worker.ts:78-88 retry-individually).
 - accumulation happens through JobItemQueue.drain_batch — the queue seam
-  built for exactly this (utils/queue.py:99).
+  built for exactly this (utils/queue.py).
 
 Round-6 pipelining: the flusher keeps up to ``pipeline_depth`` merged
 batches IN FLIGHT.  Against a stage-split verifier
@@ -31,23 +31,62 @@ is ``pipeline_depth * verifier.n_devices`` merged batches, so an 8-chip
 executor pool at depth 2 keeps 16 batches in flight and the verifier's
 least-loaded scheduler spreads them across the chips.  Single-device
 verifiers (n_devices absent or 1) behave exactly as before.
+
+Round-10 overload survival (docs/overload.md): the pool now SCHEDULES,
+not just merges.
+
+- **Priority lanes**: every job carries a ``SignatureSetPriority``
+  (block_proposal > aggregate > unaggregated > sync_committee; untagged
+  callers share the default lane).  The queue drains lane-ordered, so a
+  block proposal arriving during an attestation storm rides the very
+  next merged batch instead of queueing behind thousands of stale sets.
+- **Deadline shedding**: a job may carry an absolute ``time.monotonic()``
+  deadline; the flusher sheds expired jobs BEFORE packing, resolving
+  their futures with a typed ``VerificationDroppedError`` (never a
+  silent False — a drop is an admission decision, not a verdict).
+- **Overflow eviction**: queue overflow evicts the oldest job of the
+  lowest lane (``overflow="evict_low"``) instead of raising
+  QUEUE_MAX_LENGTH into gossip validation.
+- **Backpressure**: ``overloaded`` toggles at a pending-set high-water
+  mark (released at half) so intake (gossip router) can slow down
+  instead of OOMing.
+- Every drop lands in ``bls_pool_dropped_total{reason,lane}`` (counted
+  in SETS) plus a journal event, and a shed-rate spike across
+  ``overload_shed_threshold`` sets within ``overload_window_s`` writes
+  one rate-limited "overload" diagnostic bundle with per-lane shed
+  counts and queue depth at trigger (tools/inspect_bundle.py triages
+  it).
 """
 
 from __future__ import annotations
 
 import asyncio
 import collections
+import inspect
 import time
-from typing import List, Optional, Sequence
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 from .. import tracing
-from ..crypto.bls.verifier import IBlsVerifier, SignatureSet
+from ..crypto.bls.verifier import (
+    DEFAULT_PRIORITY,
+    IBlsVerifier,
+    SignatureSet,
+    SignatureSetPriority,
+    VerificationDroppedError,
+)
 from ..forensics.journal import JOURNAL
 from ..tracing import TRACER
-from ..utils.queue import JobItemQueue, QueueType
+from ..utils.queue import JobItemQueue, QueueError, QueueType
 from ..utils.logger import get_logger
 
 logger = get_logger("bls-pool")
+
+
+def _lane_name(lane) -> str:
+    try:
+        return SignatureSetPriority(lane).name.lower()
+    except ValueError:
+        return str(lane)
 
 
 class BlsBatchPool:
@@ -61,6 +100,10 @@ class BlsBatchPool:
         flush_threshold: int = 128,
         max_queue_length: int = 8192,
         pipeline_depth: int = 2,
+        high_water: Optional[int] = None,
+        overload_shed_threshold: int = 256,
+        overload_window_s: float = 10.0,
+        overload_cooldown_s: float = 60.0,
         metrics=None,
     ):
         self.verifier = verifier
@@ -76,14 +119,45 @@ class BlsBatchPool:
         self.batch_sets_success = 0
         self.inflight_peak = 0
         self._next_batch_id = 0  # correlation id shared by a batch's spans
+        # -- overload policy (docs/overload.md) --------------------------------
+        # high-water in pending SETS; hysteresis releases at half so a
+        # queue oscillating around the mark doesn't flap the signal
+        self.high_water = high_water if high_water else max_queue_length // 2
+        self.low_water = max(1, self.high_water // 2)
+        self.overloaded = False
+        self.overload_shed_threshold = overload_shed_threshold
+        self.overload_window_s = overload_window_s
+        self.overload_cooldown_s = overload_cooldown_s
+        self._last_overload_bundle = -1e18
+        self._shed_window: Deque[Tuple[float, int]] = collections.deque()
+        self._shed_window_sum = 0  # running sum: O(1) per drop, not O(window)
+        self._overload_task: Optional[asyncio.Task] = None
+        #: cumulative dropped sets by (reason, lane-name) — the accounting
+        #: the firehose harness and diagnostic bundles read back
+        self.dropped_sets: Dict[Tuple[str, str], int] = {}
         # max_concurrency=0: jobs are never auto-scheduled; the flusher is
-        # the only consumer, via drain_batch.
+        # the only consumer, via drain_batch.  overflow="evict_low": a full
+        # queue sheds the oldest job of the lowest lane instead of raising
+        # QUEUE_MAX_LENGTH into validation; size_fn=len keeps pending_sets
+        # O(1) (one job = a list of signature sets).
         self._queue: JobItemQueue[List[SignatureSet], bool] = JobItemQueue(
-            self._verify_job, max_length=max_queue_length, max_concurrency=0, queue_type=QueueType.FIFO
+            self._verify_job, max_length=max_queue_length, max_concurrency=0,
+            queue_type=QueueType.FIFO, overflow="evict_low", size_fn=len,
         )
         self._flush_handle: Optional[asyncio.TimerHandle] = None
         self._flushing = False
         self._closed = False
+        # verifier capabilities are fixed at construction: probe once, not
+        # per flush (inspect.signature on the hot scheduling path)
+        self._use_async = hasattr(verifier, "verify_signature_sets_async")
+        self._accepts_deadline = False
+        if self._use_async:
+            try:
+                self._accepts_deadline = "deadline" in inspect.signature(
+                    verifier.verify_signature_sets_async
+                ).parameters
+            except (TypeError, ValueError):
+                self._accepts_deadline = False
 
     async def _verify_job(self, sets: List[SignatureSet]) -> bool:
         """Fallback single-job path (unused in normal operation: the queue
@@ -92,9 +166,22 @@ class BlsBatchPool:
 
     # -- public API (chain.bls.verifySignatureSets analog) -------------------
 
-    async def verify_signature_sets(self, sets: Sequence[SignatureSet], batchable: bool = True) -> bool:
+    async def verify_signature_sets(
+        self,
+        sets: Sequence[SignatureSet],
+        batchable: bool = True,
+        priority: Optional[SignatureSetPriority] = None,
+        deadline: Optional[float] = None,
+    ) -> bool:
         """Verify a job of sets; batchable jobs may wait up to
         max_buffer_wait to share a dispatch with concurrent jobs.
+
+        ``priority`` selects the QoS lane (default: the untagged lane, so
+        existing callers behave exactly as before).  ``deadline`` is an
+        absolute ``time.monotonic()`` instant; a job still buffered past
+        it is shed with ``VerificationDroppedError`` instead of verified
+        — an attestation is worthless after its inclusion window, and
+        burning device time on it during a storm starves live traffic.
 
         An empty job raises (reference: multithread/index.ts throws on
         empty) — this is the one seam through which an empty drain could
@@ -105,16 +192,36 @@ class BlsBatchPool:
         sets = list(sets)
         if not sets:
             raise ValueError("verify_signature_sets: empty batch of signature sets")
+        lane = DEFAULT_PRIORITY if priority is None else SignatureSetPriority(priority)
         if not batchable:
             return await asyncio.to_thread(self.verifier.verify_signature_sets, sets)
         loop = asyncio.get_running_loop()
-        fut_result = loop.create_task(self._queue.push(sets))
+        fut_result = loop.create_task(
+            self._queue.push(sets, priority=int(lane), deadline=deadline)
+        )
         # the push task enqueues on its first step; check buffer state after
         loop.call_soon(self._buffered_sets_changed)
-        return await fut_result
+        try:
+            return await fut_result
+        except QueueError as e:
+            if e.code == "QUEUE_MAX_LENGTH":
+                # this job was the overflow victim: either it was evicted
+                # from the lowest lane, or everything buffered outranked it
+                self._count_drop("overflow", lane, len(sets))
+                raise VerificationDroppedError("overflow", lane) from e
+            if e.code == "QUEUE_ABORTED":
+                # close() aborted the queue while this job was buffered:
+                # same typed contract as shutdown-mid-retry — callers are
+                # written around VerificationDroppedError, never QueueError
+                self._count_drop("shutdown", lane, len(sets))
+                raise VerificationDroppedError("shutdown", lane) from e
+            raise
 
     def pending_sets(self) -> int:
-        return sum(len(item) for item, _, _ in self._queue._items)
+        """Buffered signature sets — O(1) (the queue maintains the sum;
+        the pre-round-10 deque walk here was O(n²) intake under storm
+        load, once per push via _buffered_sets_changed)."""
+        return self._queue.pending_size
 
     def close(self) -> None:
         self._closed = True
@@ -122,11 +229,129 @@ class BlsBatchPool:
             self._flush_handle.cancel()
         self._queue.abort()
 
+    # -- drop accounting ------------------------------------------------------
+
+    def _count_drop(self, reason: str, lane, n_sets: int) -> None:
+        """One bookkeeping seam for EVERY shed/evicted/shutdown set:
+        Prometheus counter, journal aggregate, firehose-readable dict, and
+        the overload-bundle rate window."""
+        name = _lane_name(lane)
+        key = (reason, name)
+        self.dropped_sets[key] = self.dropped_sets.get(key, 0) + n_sets
+        if self.metrics:
+            self.metrics.bls_pool_dropped_total.labels(
+                reason=reason, lane=name
+            ).inc(n_sets)
+        # every drop leaves journal evidence: deadline sheds are batched
+        # into one pool.shed event by _shed_expired; the push-time reasons
+        # (overflow eviction, shutdown) are recorded here per drop
+        if reason != "deadline" and JOURNAL.enabled:
+            JOURNAL.record("pool.drop", reason=reason, lane=name, sets=n_sets)
+        if not self.overload_shed_threshold:
+            return  # bundles disabled: don't grow the rate window either
+        now = time.monotonic()
+        self._shed_window.append((now, n_sets))
+        self._shed_window_sum += n_sets
+        self._maybe_overload_bundle(now)
+
+    def _maybe_overload_bundle(self, now: float) -> None:
+        """Cross the shed-rate threshold -> ONE diagnostic bundle (rate
+        limited by ``overload_cooldown_s``) so a storm leaves triageable
+        evidence: per-lane shed counts and the queue depth at trigger."""
+        if not self.overload_shed_threshold:
+            return
+        window = self._shed_window
+        while window and now - window[0][0] > self.overload_window_s:
+            self._shed_window_sum -= window.popleft()[1]
+        shed = self._shed_window_sum
+        if shed < self.overload_shed_threshold:
+            return
+        if now - self._last_overload_bundle < self.overload_cooldown_s:
+            return
+        if self._overload_task is not None and not self._overload_task.done():
+            return  # one dump at a time, whatever the cooldown says
+        self._last_overload_bundle = now
+        extra = {
+            "overload": {
+                "shed_window_sets": shed,
+                "window_s": self.overload_window_s,
+                "dropped_by_lane": self._dropped_by("lane"),
+                "dropped_by_reason": self._dropped_by("reason"),
+                "queue_depth_jobs": len(self._queue),
+                "pending_sets": self.pending_sets(),
+                "backpressure": self.overloaded,
+            }
+        }
+        JOURNAL.record(
+            "pool.overload", level="ERROR", shed_window_sets=shed,
+            pending_sets=self.pending_sets(),
+        )
+
+        def _dump() -> None:
+            from ..forensics.recorder import RECORDER
+
+            try:
+                RECORDER.dump("overload", extra=extra, metric_reason="overload")
+            except Exception:  # a broken dump path must never hit the flusher
+                logger.exception("overload bundle failed")
+
+        # bundle writing is file I/O: keep it off the event loop; strong
+        # ref so the task survives (the loop holds tasks weakly)
+        self._overload_task = asyncio.get_running_loop().create_task(
+            asyncio.to_thread(_dump)
+        )
+
+    def _dropped_by(self, axis: str) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for (reason, lane), n in self.dropped_sets.items():
+            k = reason if axis == "reason" else lane
+            out[k] = out.get(k, 0) + n
+        return out
+
+    # -- backpressure ----------------------------------------------------------
+
+    def _update_backpressure(self) -> None:
+        pending = self.pending_sets()
+        if not self.overloaded and pending >= self.high_water:
+            self.overloaded = True
+            if self.metrics:
+                self.metrics.bls_pool_backpressure.set(1)
+            JOURNAL.record(
+                "pool.backpressure", level="WARNING", on=True,
+                pending_sets=pending, high_water=self.high_water,
+            )
+            logger.warning(
+                "bls pool backpressure ON: %d pending sets (high water %d)",
+                pending, self.high_water,
+            )
+        elif self.overloaded and pending <= self.low_water:
+            self.overloaded = False
+            if self.metrics:
+                self.metrics.bls_pool_backpressure.set(0)
+            JOURNAL.record(
+                "pool.backpressure", on=False, pending_sets=pending,
+                low_water=self.low_water,
+            )
+            logger.info(
+                "bls pool backpressure off: %d pending sets (low water %d)",
+                pending, self.low_water,
+            )
+
+    def _publish_lane_gauges(self) -> None:
+        if not self.metrics:
+            return
+        lengths = self._queue.lane_lengths()
+        for lane in SignatureSetPriority:
+            self.metrics.bls_pool_lane_pending.labels(
+                lane=lane.name.lower()
+            ).set(lengths.get(int(lane), 0))
+
     # -- flushing -------------------------------------------------------------
 
     def _buffered_sets_changed(self) -> None:
         if self.metrics:
             self.metrics.bls_pool_queue_length.set(self.pending_sets())
+        self._update_backpressure()
         if self.pending_sets() >= self.flush_threshold:
             self._schedule_flush(0.0)
         elif self._flush_handle is None:
@@ -143,17 +368,51 @@ class BlsBatchPool:
         if not self._flushing:
             asyncio.get_running_loop().create_task(self._flush())
 
+    def _shed_expired(self, drained: List[Tuple], cid: int) -> List[Tuple]:
+        """Drop drained jobs whose deadline already passed, BEFORE any
+        pack work is spent on them.  Each shed future resolves with the
+        typed ``VerificationDroppedError`` (IGNORE upstream, never a
+        False 'invalid signature'); the survivors are returned."""
+        now = time.monotonic()
+        live: List[Tuple] = []
+        shed_by_lane: Dict[str, int] = {}
+        for item, fut, t_enq, lane, deadline in drained:
+            if deadline is None or now <= deadline:
+                live.append((item, fut, t_enq, lane, deadline))
+                continue
+            lane_p = SignatureSetPriority(lane)
+            self._count_drop("deadline", lane_p, len(item))
+            shed_by_lane[_lane_name(lane)] = (
+                shed_by_lane.get(_lane_name(lane), 0) + len(item)
+            )
+            if TRACER.enabled:
+                TRACER.add_span(
+                    "bls.shed", "pool", int(t_enq * 1e9), int(now * 1e9),
+                    cid=cid, lane=_lane_name(lane), reason="deadline",
+                    sets=len(item),
+                )
+            if not fut.done():
+                fut.set_exception(VerificationDroppedError("deadline", lane_p))
+        if shed_by_lane and JOURNAL.enabled:
+            JOURNAL.record(
+                "pool.shed", level="WARNING", cid=cid, reason="deadline",
+                sets=sum(shed_by_lane.values()), by_lane=shed_by_lane,
+            )
+        return live
+
     async def _flush(self) -> None:
         """Pipelined drain: keep up to ``pipeline_depth * n_devices``
-        merged batches in flight.  The fill half packs + enqueues batch
-        N+1 (host CPU work on a worker thread; the device dispatch itself
-        is async) while the drain half reads back the OLDEST in-flight
-        batch's verdict — so the host final exponentiation of batch N runs
-        concurrently with the device compute of batch N+1, and a
-        multi-device verifier's scheduler sees enough batches to feed
-        every chip."""
+        merged batches in flight.  The fill half sheds expired jobs, then
+        packs + enqueues batch N+1 (host CPU work on a worker thread; the
+        device dispatch itself is async) while the drain half reads back
+        the OLDEST in-flight batch's verdict — so the host final
+        exponentiation of batch N runs concurrently with the device
+        compute of batch N+1, and a multi-device verifier's scheduler
+        sees enough batches to feed every chip.  Batches drain
+        lane-ordered: the queue hands back block proposals first."""
         self._flushing = True
-        use_async = hasattr(self.verifier, "verify_signature_sets_async")
+        use_async = self._use_async
+        accepts_deadline = self._accepts_deadline
         inflight: collections.deque = collections.deque()
         flush_t0 = time.monotonic()
         busy = 0.0  # sum of per-batch pack-start->verdict wall (overlap ratio)
@@ -163,21 +422,37 @@ class BlsBatchPool:
         window = self.pipeline_depth * max(1, getattr(self.verifier, "n_devices", 1))
         try:
             while len(self._queue) or inflight:
-                # fill the window
+                # fill the window.  max_size keeps each merged batch near
+                # the dispatch-sized flush_threshold even when a storm
+                # backlog sits in the queue — lane priority is only real
+                # if the block lane rides the NEXT batch, not the middle
+                # of one mega-batch (a single oversized job still drains
+                # alone and chunks verifier-side).
                 while len(self._queue) and len(inflight) < window:
                     drained = self._queue.drain_batch(
-                        max_items=1024, with_enqueue_time=True
+                        max_items=1024, with_meta=True,
+                        max_size=max(self.flush_threshold, 1),
                     )
                     if not drained:
                         break
                     cid = self._next_batch_id
                     self._next_batch_id += 1
+                    drained = self._shed_expired(drained, cid)
+                    if not drained:
+                        self._update_backpressure()
+                        continue  # the whole drain was expired backlog
                     now = time.monotonic()
                     jobs: List = []
                     merged: List[SignatureSet] = []
-                    for item, fut, t_enq in drained:
-                        jobs.append((item, fut))
+                    batch_deadline: Optional[float] = None
+                    for item, fut, t_enq, lane, deadline in drained:
+                        jobs.append((item, fut, lane))
                         merged.extend(item)
+                        if deadline is not None:
+                            batch_deadline = (
+                                deadline if batch_deadline is None
+                                else min(batch_deadline, deadline)
+                            )
                         if self.metrics:
                             self.metrics.bls_pool_queue_wait_seconds.observe(
                                 now - t_enq
@@ -186,8 +461,10 @@ class BlsBatchPool:
                             TRACER.add_span(
                                 "bls.queue_wait", "queue",
                                 int(t_enq * 1e9), int(now * 1e9),
-                                cid=cid, sets=len(item),
+                                cid=cid, sets=len(item), lane=_lane_name(lane),
                             )
+                    self._update_backpressure()
+                    self._publish_lane_gauges()
                     if self.metrics:
                         self.metrics.bls_pool_dispatches_total.inc()
                         self.metrics.bls_pool_batch_size.observe(len(merged))
@@ -209,10 +486,19 @@ class BlsBatchPool:
                     try:
                         if use_async:
                             # pack on a worker thread; returns once the
-                            # device program is ENQUEUED, not finished
-                            pending = await asyncio.to_thread(
-                                self.verifier.verify_signature_sets_async, merged
-                            )
+                            # device program is ENQUEUED, not finished.  The
+                            # batch's tightest job deadline rides along so
+                            # dispatch placement / the in-flight table see it.
+                            if accepts_deadline:
+                                pending = await asyncio.to_thread(
+                                    self.verifier.verify_signature_sets_async,
+                                    merged, deadline=batch_deadline,
+                                )
+                            else:
+                                pending = await asyncio.to_thread(
+                                    self.verifier.verify_signature_sets_async,
+                                    merged,
+                                )
                             # executor name the scheduler picked (None for a
                             # chunked batch spread over several devices)
                             device = getattr(pending, "device", None)
@@ -268,7 +554,7 @@ class BlsBatchPool:
                     self.metrics.bls_pool_inflight_depth.set(len(inflight))
                 if ok:
                     self.batch_sets_success += len(merged)
-                    for _item, fut in jobs:
+                    for _item, fut, _lane in jobs:
                         if not fut.done():
                             fut.set_result(True)
                     continue
@@ -276,17 +562,31 @@ class BlsBatchPool:
                 # innocent jobs still succeed (worker.ts:78-88)
                 self.batch_retries += 1
                 logger.debug("merged batch of %d jobs failed; retrying individually", len(jobs))
-                for item, fut in jobs:
+                for item, fut, lane in jobs:
                     if fut.done():
+                        continue
+                    if self._closed:
+                        # shutdown mid-retry: resolve (typed), never strand —
+                        # an awaiting validator task must not hang forever
+                        # on a pool that no longer has a verifier behind it
+                        lane_p = SignatureSetPriority(lane)
+                        self._count_drop("shutdown", lane_p, len(item))
+                        fut.set_exception(
+                            VerificationDroppedError("shutdown", lane_p)
+                        )
                         continue
                     try:
                         one = await asyncio.to_thread(self.verifier.verify_signature_sets, item)
                     except Exception as e:  # noqa: BLE001
-                        fut.set_exception(e)
+                        if not fut.done():  # pusher cancelled during the await
+                            fut.set_exception(e)
                         continue
-                    fut.set_result(one)
+                    if not fut.done():  # ditto — set on a cancelled future
+                        fut.set_result(one)  # raises and would kill the flusher
         finally:
             self._flushing = False
+            self._update_backpressure()
+            self._publish_lane_gauges()
             self._publish_flush_metrics(busy, time.monotonic() - flush_t0, sets_done)
             if len(self._queue):
                 self._buffered_sets_changed()

@@ -19,7 +19,15 @@ from ..params.presets import SYNC_COMMITTEE_SUBNET_COUNT
 from ..ssz import Fields
 from ..state_transition import compute_epoch_at_slot, compute_signing_root, get_domain
 from ..types import get_types
-from .validation import GossipAction, GossipValidationError, _ignore, _reject
+from ..crypto.bls.verifier import SignatureSetPriority
+from .validation import (
+    GossipAction,
+    GossipValidationError,
+    _ignore,
+    _pool_verify,
+    _reject,
+    _storm_deadline,
+)
 
 G2_INFINITY_SIG = b"\xc0" + b"\x00" * 95
 
@@ -183,7 +191,11 @@ async def validate_sync_committee_message(
         signing_root=signing_root,
         signature=bytes(message.signature),
     )
-    if not await pool.verify_signature_sets([sig_set], batchable=True):
+    if not await _pool_verify(
+        pool, [sig_set], batchable=True,
+        priority=SignatureSetPriority.SYNC_COMMITTEE,
+        deadline=_storm_deadline(cfg),
+    ):
         _reject("INVALID_SIGNATURE")
     if seen_sync_msgs.is_known(message.slot, subnet, vi):
         _ignore("ALREADY_SEEN")
@@ -278,7 +290,15 @@ async def validate_sync_committee_contribution(
             signature=bytes(contribution.signature),
         )
     )
-    if not await pool.verify_signature_sets(sets, batchable=True):
+    # contributions ride the AGGREGATE lane, not SYNC_COMMITTEE: they are
+    # the sync-committee analog of aggregate_and_proof (~1/512 of message
+    # volume), and gossip intake deliberately never sheds them — admitting
+    # them at intake only to make them the pool's first eviction victim
+    # would be a priority inversion
+    if not await _pool_verify(
+        pool, sets, batchable=True,
+        priority=SignatureSetPriority.AGGREGATE,
+    ):
         _reject("INVALID_SIGNATURE")
     if key in seen_contributions:
         _ignore("ALREADY_SEEN")

@@ -17,6 +17,7 @@ the same way, test/utils/mocks/chain.ts).
 from __future__ import annotations
 
 import enum
+import time
 from typing import List, Optional, Sequence
 
 from ..config.chain_config import ChainConfig
@@ -30,7 +31,11 @@ from ..state_transition.signature_sets import (
     proposer_slashing_signature_sets,
     voluntary_exit_signature_set,
 )
-from ..crypto.bls.verifier import SingleSignatureSet
+from ..crypto.bls.verifier import (
+    SignatureSetPriority,
+    SingleSignatureSet,
+    VerificationDroppedError,
+)
 from ..types import get_types
 
 
@@ -52,6 +57,36 @@ def _reject(code: str):
 
 def _ignore(code: str):
     raise GossipValidationError(GossipAction.IGNORE, code)
+
+
+async def _pool_verify(pool, sets, *, batchable=True, priority=None, deadline=None):
+    """pool.verify_signature_sets with the QoS lane + deadline threaded
+    through and the overload contract applied: a job the pool SHED
+    (deadline expiry, overflow eviction — VerificationDroppedError) maps
+    to IGNORE, never REJECT — the node's own admission decision must not
+    downscore the relaying peer or mark the message invalid.
+
+    Plain verifiers that predate the ``priority`` kwarg (test doubles,
+    IBlsVerifier facades) are driven through the legacy signature."""
+    try:
+        coro = pool.verify_signature_sets(
+            sets, batchable=batchable, priority=priority, deadline=deadline
+        )
+    except TypeError:  # pool without QoS lanes: legacy signature
+        coro = pool.verify_signature_sets(sets, batchable=batchable)
+    try:
+        return await coro
+    except VerificationDroppedError:
+        _ignore("VERIFICATION_DROPPED")
+
+
+def _storm_deadline(cfg: ChainConfig) -> float:
+    """Deadline stamped on storm-lane gossip jobs (single attestations,
+    per-subnet sync-committee messages): one slot from intake.  Their
+    propagation value decays within the slot — a job still buffered a
+    full slot later is stale backlog the flusher sheds instead of burning
+    device time on (docs/overload.md §Deadline shedding)."""
+    return time.monotonic() + cfg.SECONDS_PER_SLOT
 
 
 async def validate_gossip_attestation(
@@ -97,7 +132,11 @@ async def validate_gossip_attestation(
 
     indexed = ctx.get_indexed_attestation(attestation)
     sig_set = indexed_attestation_signature_set(p, ctx, state, indexed)
-    if not await pool.verify_signature_sets([sig_set], batchable=True):
+    if not await _pool_verify(
+        pool, [sig_set], batchable=True,
+        priority=SignatureSetPriority.UNAGGREGATED,
+        deadline=_storm_deadline(cfg),
+    ):
         _reject("INVALID_SIGNATURE")
     # re-check after the async hop (attestation.ts:142-153 race guard)
     if seen_attesters.is_known(target_epoch, attester):
@@ -194,7 +233,10 @@ async def validate_gossip_aggregate_and_proof(
     )
     indexed = ctx.get_indexed_attestation(aggregate)
     att_set = indexed_attestation_signature_set(p, ctx, state, indexed)
-    if not await pool.verify_signature_sets([selection_set, aggregator_set, att_set], batchable=True):
+    if not await _pool_verify(
+        pool, [selection_set, aggregator_set, att_set], batchable=True,
+        priority=SignatureSetPriority.AGGREGATE,
+    ):
         _reject("INVALID_SIGNATURE")
     seen_aggregators.add(target_epoch, aggregator)
     seen_aggregates.add(target_epoch, data_root, aggregate.aggregation_bits)
@@ -246,7 +288,10 @@ async def validate_gossip_block(
     if block.proposer_index != expected_proposer:
         _reject("INCORRECT_PROPOSER")
     sig_set = block_proposer_signature_set(p, ctx, state, signed_block)
-    if not await pool.verify_signature_sets([sig_set], batchable=False):
+    if not await _pool_verify(
+        pool, [sig_set], batchable=False,
+        priority=SignatureSetPriority.BLOCK_PROPOSAL,
+    ):
         _reject("PROPOSAL_SIGNATURE_INVALID")
     seen_block_proposers.add(block.slot, block.proposer_index)
 
@@ -275,8 +320,12 @@ async def validate_gossip_voluntary_exit(
         or current_epoch < v.activation_epoch + cfg.SHARD_COMMITTEE_PERIOD
     ):
         _reject("INVALID_EXIT")
-    if not await pool.verify_signature_sets(
-        [voluntary_exit_signature_set(p, ctx, state, signed_exit)], batchable=True
+    # exits (like slashings below) are rare, irreplaceable op-pool
+    # messages gossip never sheds at intake: ride the AGGREGATE lane so
+    # the overflow policy can't sacrifice them to storm traffic
+    if not await _pool_verify(
+        pool, [voluntary_exit_signature_set(p, ctx, state, signed_exit)],
+        batchable=True, priority=SignatureSetPriority.AGGREGATE,
     ):
         _reject("INVALID_SIGNATURE")
 
@@ -295,8 +344,9 @@ async def validate_gossip_proposer_slashing(
         _reject("HEADERS_EQUAL")
     if not is_slashable_validator(state.validators[idx], compute_epoch_at_slot(p, state.slot)):
         _reject("NOT_SLASHABLE")
-    if not await pool.verify_signature_sets(
-        proposer_slashing_signature_sets(p, ctx, state, slashing), batchable=True
+    if not await _pool_verify(
+        pool, proposer_slashing_signature_sets(p, ctx, state, slashing),
+        batchable=True, priority=SignatureSetPriority.AGGREGATE,
     ):
         _reject("INVALID_SIGNATURE")
 
@@ -312,7 +362,8 @@ async def validate_gossip_attester_slashing(
     epoch = compute_epoch_at_slot(p, state.slot)
     if not any(is_slashable_validator(state.validators[i], epoch) for i in intersection):
         _ignore("NO_SLASHABLE_VALIDATORS")
-    if not await pool.verify_signature_sets(
-        attester_slashing_signature_sets(p, ctx, state, slashing), batchable=True
+    if not await _pool_verify(
+        pool, attester_slashing_signature_sets(p, ctx, state, slashing),
+        batchable=True, priority=SignatureSetPriority.AGGREGATE,
     ):
         _reject("INVALID_SIGNATURE")

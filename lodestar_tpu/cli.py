@@ -126,6 +126,25 @@ def build_parser() -> argparse.ArgumentParser:
             "(docs/dispatch_pipeline.md)",
         )
         p.add_argument(
+            "--bls-max-queue-length", type=int, default=8192,
+            help="verification jobs the pool queue holds before the "
+            "overflow policy evicts the oldest job of the lowest QoS "
+            "lane (docs/overload.md; the pre-overload behavior raised "
+            "QUEUE_MAX_LENGTH into gossip validation instead)",
+        )
+        p.add_argument(
+            "--bls-high-water", type=int, default=0,
+            help="pending signature sets that flip the pool into "
+            "backpressure (gossip slows storm-topic intake; released at "
+            "half).  0 = half of --bls-max-queue-length",
+        )
+        p.add_argument(
+            "--bls-overload-bundle-threshold", type=int, default=256,
+            help="shed sets within a 10s window that trigger ONE "
+            "rate-limited 'overload' diagnostic bundle with per-lane "
+            "shed counts (0 disables; docs/overload.md)",
+        )
+        p.add_argument(
             "--bls-point-cache-size", type=int, default=8192,
             help="entries in the pack-stage LRU of decompressed/affine "
             "points keyed by compressed bytes (0 disables; attestation "
@@ -359,6 +378,11 @@ def _make_pool(args, metrics=None):
         max_buffer_wait=getattr(args, "bls_buffer_wait_ms", 20.0) / 1e3,
         flush_threshold=getattr(args, "bls_flush_threshold", 128),
         pipeline_depth=getattr(args, "bls_pipeline_depth", 2),
+        max_queue_length=getattr(args, "bls_max_queue_length", 8192),
+        high_water=getattr(args, "bls_high_water", 0) or None,
+        overload_shed_threshold=getattr(
+            args, "bls_overload_bundle_threshold", 256
+        ),
         metrics=metrics,
     )
 

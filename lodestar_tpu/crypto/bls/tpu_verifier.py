@@ -128,10 +128,10 @@ class PendingVerdict:
     placement sees the device free again."""
 
     __slots__ = ("_verifier", "_f", "_ok", "_out", "_value", "_parts", "_release",
-                 "device")
+                 "device", "deadline")
 
     def __init__(self, verifier=None, f=None, ok=None, out=None, value=None,
-                 parts=None, release=None, device=None):
+                 parts=None, release=None, device=None, deadline=None):
         self._verifier = verifier
         self._f = f
         self._ok = ok
@@ -140,6 +140,7 @@ class PendingVerdict:
         self._parts = parts
         self._release = release
         self.device = device  # executor name the batch landed on (None for chunked)
+        self.deadline = deadline  # tightest job deadline riding this batch
 
     def done_hint(self) -> bool:
         """True once the verdict is cached (no sync performed)."""
@@ -495,7 +496,7 @@ class TpuBlsVerifier:
         return self.verify_signature_sets_async(sets).result()
 
     def verify_signature_sets_async(
-        self, sets: Sequence[SignatureSet]
+        self, sets: Sequence[SignatureSet], deadline: Optional[float] = None
     ) -> PendingVerdict:
         """Pack + enqueue without waiting for the device: the returned
         handle's ``result()`` is the only sync.  Oversized batches chunk
@@ -503,6 +504,13 @@ class TpuBlsVerifier:
         chunk N+1's pack overlaps chunk N's device time even on the
         single-caller path, and on a multi-device pool the scheduler fans
         the chunks out round-robin across the executors.
+
+        ``deadline`` (absolute ``time.monotonic()``, optional) is the
+        tightest job deadline riding the batch — the scheduling layer
+        (chain/bls_pool) sheds expired jobs before packing, so by the
+        time a deadline reaches here it is informational: dispatch
+        records it in the journal and the in-flight table so a stalled
+        batch's bundle can say whether its work was already worthless.
 
         An empty batch is a caller bug, not a verification failure — the
         reference throws (multithread/index.ts verifySignatureSets), and a
@@ -514,16 +522,16 @@ class TpuBlsVerifier:
         if len(sets) > largest:
             # split oversized batches (chunkify analog, multithread/utils.ts:4)
             parts = [
-                self.verify_signature_sets_async(sets[i : i + largest])
+                self.verify_signature_sets_async(sets[i : i + largest], deadline)
                 for i in range(0, len(sets), largest)
             ]
-            return PendingVerdict(parts=parts)
+            return PendingVerdict(parts=parts, deadline=deadline)
         packed = self.pack(sets)
         if packed is None:
             return PendingVerdict(value=False)  # malformed bytes / infinity
-        return self.dispatch(packed)
+        return self.dispatch(packed, deadline=deadline)
 
-    def dispatch(self, packed) -> PendingVerdict:
+    def dispatch(self, packed, deadline: Optional[float] = None) -> PendingVerdict:
         """Place one packed batch on the least-loaded device executor and
         enqueue it — returns immediately (the jax dispatch is
         asynchronous; compile, if cold, is not).  The executor's in-flight
@@ -575,12 +583,20 @@ class TpuBlsVerifier:
         # flight recorder: placement decision into the black box, the
         # batch into the in-flight table the watchdog scans — resolved by
         # the same exactly-once path that returns the executor slot, so a
-        # verdict that never syncs leaves a stall-shaped entry behind
+        # verdict that never syncs leaves a stall-shaped entry behind.
+        # The remaining deadline headroom (seconds, negative = already
+        # expired) rides both records: a stall bundle can then say whether
+        # the wedged work was still worth anything.
+        headroom = None
+        if deadline is not None:
+            headroom = round(deadline - time.monotonic(), 3)
         if JOURNAL.enabled:
             JOURNAL.record("bls.dispatch", cid=cid, device=ex.name, bucket=n,
                            sets=live, fused=used_fused,
-                           inflight=ex.inflight, devices_total=self.n_devices)
-        token = INFLIGHT.register(cid=cid, device=ex.name, bucket=n, sets=live)
+                           inflight=ex.inflight, devices_total=self.n_devices,
+                           deadline_headroom_s=headroom)
+        token = INFLIGHT.register(cid=cid, device=ex.name, bucket=n, sets=live,
+                                  deadline_s=headroom)
 
         def release():
             INFLIGHT.resolve(token)
@@ -589,9 +605,9 @@ class TpuBlsVerifier:
         if self.host_final_exp:
             f, ok = out
             return PendingVerdict(verifier=self, f=f, ok=ok, release=release,
-                                  device=ex.name)
+                                  device=ex.name, deadline=deadline)
         return PendingVerdict(verifier=self, out=out, release=release,
-                              device=ex.name)
+                              device=ex.name, deadline=deadline)
 
     def close(self) -> None:
         for ex in self._executors:

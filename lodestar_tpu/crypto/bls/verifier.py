@@ -17,9 +17,11 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import enum
 import threading
 from typing import List, Optional, Protocol, Sequence, Tuple, Union
 
+from ...utils.errors import LodestarError
 from .api import (
     PublicKey,
     Signature,
@@ -30,6 +32,47 @@ from .api import (
 
 # Matches MIN_SET_COUNT_TO_BATCH (maybeBatch.ts:4)
 MIN_SET_COUNT_TO_BATCH = 2
+
+
+class SignatureSetPriority(enum.IntEnum):
+    """QoS lane of a verification job (lower value = drained first).
+
+    Mirrors the reference's gossip-queue separation (one JobItemQueue per
+    topic with blocks ahead of attestations, network/processor/gossipQueues)
+    collapsed onto the ONE device pool this stack batches through: under
+    overload a block proposal must never wait behind thousands of stale
+    unaggregated attestations, and when something has to be dropped it is
+    the lowest lane first."""
+
+    BLOCK_PROPOSAL = 0
+    AGGREGATE = 1
+    UNAGGREGATED = 2
+    SYNC_COMMITTEE = 3
+
+
+#: lane for callers that do not tag their jobs.  All untagged jobs share
+#: one lane, so a pool fed exclusively by untagged callers behaves exactly
+#: as it did before lanes existed (FIFO, single drain order).
+DEFAULT_PRIORITY = SignatureSetPriority.UNAGGREGATED
+
+
+class VerificationDroppedError(LodestarError):
+    """A verification job was shed by the overload policy — deadline
+    expiry, queue overflow eviction, or pool shutdown — and was therefore
+    NEVER verified.  Distinct from a ``False`` verdict on purpose: False
+    means "cryptographically invalid" and triggers REJECT + peer
+    downscoring; a dropped job is the node's own admission decision and
+    must surface as IGNORE/backoff upstream."""
+
+    def __init__(self, reason: str, lane: Optional["SignatureSetPriority"] = None):
+        lane_name = lane.name if lane is not None else None
+        super().__init__(
+            {"code": "VERIFICATION_DROPPED", "reason": reason, "lane": lane_name},
+            f"verification dropped ({reason}"
+            + (f", lane {lane_name})" if lane_name else ")"),
+        )
+        self.reason = reason
+        self.lane = lane
 
 
 @dataclasses.dataclass

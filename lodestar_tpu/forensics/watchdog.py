@@ -38,13 +38,19 @@ class InflightTable:
         self._next = 0
 
     def register(self, cid: Optional[int] = None, device: Optional[str] = None,
-                 bucket: Optional[int] = None, sets: Optional[int] = None) -> int:
-        """Record one enqueued batch; returns the token ``resolve`` takes."""
+                 bucket: Optional[int] = None, sets: Optional[int] = None,
+                 deadline_s: Optional[float] = None) -> int:
+        """Record one enqueued batch; returns the token ``resolve`` takes.
+        ``deadline_s`` is the batch's remaining QoS-deadline headroom at
+        dispatch time (negative = already expired) — it rides every
+        snapshot so a stall bundle can say whether the wedged work still
+        mattered."""
         entry = {
             "cid": cid,
             "device": device,
             "bucket": bucket,
             "sets": sets,
+            "deadline_s": deadline_s,
             "t0_ns": time.monotonic_ns(),
             "stalled": False,
         }

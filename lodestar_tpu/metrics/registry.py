@@ -172,6 +172,27 @@ class Metrics:
             "pack-stage rejections (malformed bytes or infinity point; "
             "the batch never dispatched)",
         )
+        # overload survival: QoS lanes, shedding, backpressure (round 10,
+        # docs/overload.md)
+        self.bls_pool_dropped_total = r.counter(
+            "lodestar_bls_pool_dropped_total",
+            "signature sets dropped by the overload policy instead of "
+            "verified (deadline shed / overflow eviction / shutdown), "
+            "by reason and QoS lane — every drop is accounted here",
+            labels=("reason", "lane"),
+        )
+        self.bls_pool_backpressure = r.gauge(
+            "lodestar_bls_pool_backpressure",
+            "1 while pending sets sit above the pool high-water mark "
+            "(gossip intake slows its sheddable topics), 0 once drained "
+            "below the low-water release point",
+        )
+        self.bls_pool_lane_pending = r.gauge(
+            "lodestar_bls_pool_lane_pending",
+            "pending verification jobs per QoS lane "
+            "(block_proposal/aggregate/unaggregated/sync_committee)",
+            labels=("lane",),
+        )
         # flight recorder & failure forensics (round 9)
         self.bls_watchdog_stalls_total = r.counter(
             "lodestar_bls_watchdog_stalls_total",

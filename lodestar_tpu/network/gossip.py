@@ -180,12 +180,31 @@ class _PeerState:
         return s
 
 
+def sheddable_topic(name: str) -> bool:
+    """Topics whose intake may slow under BLS-pool backpressure: the
+    per-subnet storm traffic (unaggregated attestations, sync-committee
+    messages).  Blocks, aggregates, contributions, and the rare op-pool
+    topics always flow — under overload they are exactly what the node
+    must keep validating."""
+    return name.startswith("beacon_attestation_") or (
+        name.startswith("sync_committee_") and name != TOPIC_SYNC_CONTRIBUTION
+    )
+
+
 class GossipRouter:
     """Scored-mesh pubsub over per-peer send callables.
 
     ``on_reject``: (peer_key, code) when a peer relays a REJECTed message
     (feeds the RPC score store).  ``on_evict``: (peer_key, score) when a
-    peer's gossip score crosses the graylist threshold."""
+    peer's gossip score crosses the graylist threshold.
+
+    ``backpressure``: zero-arg callable read per inbound message; while it
+    returns True (the BLS pool is above its high-water mark) sheddable
+    topics are dropped AT INTAKE — before validation, before the pool —
+    so the verification queue stops growing instead of OOMing
+    (docs/overload.md §Backpressure).  Dropped intake is counted in
+    ``gossip_queue_dropped_total{topic}``; the message is not forwarded
+    (it was never validated) and the sender is not penalized."""
 
     def __init__(
         self,
@@ -193,6 +212,7 @@ class GossipRouter:
         on_evict: Optional[Callable[[str, float], None]] = None,
         heartbeat_interval: float = HEARTBEAT_INTERVAL,
         metrics=None,
+        backpressure: Optional[Callable[[], bool]] = None,
     ):
         self.metrics = metrics
         self.subscriptions: Dict[str, Callable[[bytes], Awaitable[None]]] = {}
@@ -201,6 +221,8 @@ class GossipRouter:
         self.mesh: Dict[str, Set[str]] = {}
         self.on_reject = on_reject
         self.on_evict = on_evict
+        self.backpressure = backpressure
+        self.backpressure_dropped = 0
         self.heartbeat_interval = heartbeat_interval
         self._mcache: Dict[bytes, Tuple[str, bytes]] = {}
         self._mcache_windows: deque = deque(maxlen=MCACHE_LEN)
@@ -296,6 +318,18 @@ class GossipRouter:
             self.peers[from_peer].topic_counters(topic).first_message_deliveries += 1
         handler = self.subscriptions.get(topic)
         if handler is None:
+            return
+        name = parse_topic(topic) or topic
+        if (
+            self.backpressure is not None
+            and sheddable_topic(name)
+            and self.backpressure()
+        ):
+            # overload: shed storm-lane intake before it reaches the
+            # validation queue (the pool's high-water mark is the signal)
+            self.backpressure_dropped += 1
+            if self.metrics:
+                self.metrics.gossip_queue_dropped_total.labels(topic=name).inc()
             return
         from ..chain.validation import GossipAction, GossipValidationError
 

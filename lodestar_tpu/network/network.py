@@ -61,6 +61,11 @@ class Network:
         self.router = GossipRouter(
             on_reject=self._on_gossip_reject, on_evict=self._on_gossip_evict,
             metrics=metrics,
+            # storm-topic intake slows while the BLS pool sits above its
+            # high-water mark (docs/overload.md §Backpressure)
+            backpressure=lambda: getattr(
+                getattr(chain, "bls", None), "overloaded", False
+            ),
         )
         # subnet services + seq-numbered metadata (SURVEY §2.5 attnets/
         # syncnets; served to peers over reqresp METADATA)

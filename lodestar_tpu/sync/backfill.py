@@ -15,9 +15,11 @@ every block's proposer signature is verified in ONE batched verifier call
 
 from __future__ import annotations
 
+import asyncio
 from typing import List, Optional
 
 from ..config.chain_config import ChainConfig
+from ..crypto.bls.verifier import VerificationDroppedError
 from ..params import DOMAIN_BEACON_PROPOSER, Preset
 from ..state_transition import compute_epoch_at_slot
 from ..state_transition.domain import compute_domain, compute_signing_root
@@ -57,6 +59,9 @@ class BackfillSync:
         else:
             self.oldest_root_parent = None
         self.backfilled_to: Optional[int] = None
+        # pause before retrying a window whose verification the overloaded
+        # BLS pool shed (tests set 0)
+        self.shed_backoff_s = 1.0
 
     # -- verification ----------------------------------------------------------
 
@@ -175,6 +180,18 @@ class BackfillSync:
                     peer.penalize(5)
                     continue
                 stored += await self._verify_and_store(blocks)
+            except VerificationDroppedError as e:
+                # the pool shed OUR job (overload admission, docs/overload.md)
+                # — backfill deliberately rides the default lane so it is
+                # among the first work shed under storm, but the node's own
+                # admission decision must never score the serving peer.
+                # Back off before retrying the window: looping straight
+                # back into a full pool re-downloads 64 blocks per spin and
+                # amplifies load during the exact condition shedding
+                # relieves.
+                logger.info("backfill batch shed by bls pool (%s); backing off", e.reason)
+                await asyncio.sleep(self.shed_backoff_s)
+                continue
             except Exception as e:  # noqa: BLE001
                 peer.penalize(10)
                 logger.warning("backfill batch failed: %s", e)

@@ -38,6 +38,11 @@ import sys
 from typing import Any, Dict, List
 
 PIPELINE_SPANS = ("bls.queue_wait", "bls.pack", "bls.dispatch", "bls.final_exp")
+#: spans that legitimately END a batch early: a cid whose jobs were shed
+#: by the overload policy (chain/bls_pool deadline shedding) never reaches
+#: pack/dispatch — --require-pipeline must not count it as a broken
+#: pipeline, and its presence is reported, not errored
+SHED_SPAN = "bls.shed"
 _TS_PHASES = {"X", "B", "E", "i", "I"}
 
 
@@ -94,12 +99,18 @@ def validate_pipeline(trace: Any, min_batches: int = 2) -> List[str]:
     dispatches spread over >= 2 distinct device ids."""
     events = trace.get("traceEvents", trace) if isinstance(trace, dict) else trace
     by_cid: Dict[Any, Dict[str, float]] = {}
+    shed_cids = set()
     devices_seen = set()
     devices_total = 1
     for ev in events:
         if not isinstance(ev, dict) or ev.get("ph") != "X":
             continue
         name = ev.get("name")
+        if name == SHED_SPAN:
+            cid = (ev.get("args") or {}).get("cid", ev.get("id"))
+            if cid is not None:
+                shed_cids.add(cid)
+            continue
         if name not in PIPELINE_SPANS:
             continue
         args = ev.get("args") or {}
@@ -119,10 +130,18 @@ def validate_pipeline(trace: Any, min_batches: int = 2) -> List[str]:
     ]
     errors: List[str] = []
     if len(complete) < min_batches:
+        # a cid whose jobs were entirely shed (bls.shed) is an overload
+        # decision, not a broken pipeline — exclude it from the partials
+        partial = {
+            cid: sorted(st)
+            for cid, st in by_cid.items()
+            if cid not in shed_cids
+        }
         errors.append(
             f"pipeline: need >= {min_batches} batches with correlated non-zero "
             f"{'/'.join(PIPELINE_SPANS)} spans, found {len(complete)} "
-            f"(partial batches: { {cid: sorted(st) for cid, st in by_cid.items()} })"
+            f"({len(shed_cids)} shed batches excluded; "
+            f"partial batches: {partial})"
         )
     if devices_total > 1 and len(devices_seen) < 2:
         errors.append(

@@ -187,8 +187,15 @@ def summarize(bundle_dir: str) -> Dict[str, Any]:
         "trace_spans": manifest.get("trace", {}).get("spans"),
         "trace_dropped": manifest.get("trace", {}).get("dropped"),
         "last_compile": compiles[-1] if compiles else None,
+        # overload bundles (shed-rate trigger, chain/bls_pool): per-lane
+        # shed counts and queue depth at trigger — the first thing a
+        # responder needs for a "node under storm" death
+        "overload": manifest.get("overload"),
         "stalled": [
-            {k: e.get(k) for k in ("cid", "device", "bucket", "sets", "age_s")}
+            {
+                k: e.get(k)
+                for k in ("cid", "device", "bucket", "sets", "age_s", "deadline_s")
+            }
             for e in manifest.get("stalled") or []
         ],
         "inflight_per_device": per_device,
@@ -213,11 +220,27 @@ def _print_text(s: Dict[str, Any]) -> None:
               f"(wall {lc.get('wall')})")
     else:
         print("last compile  none recorded")
+    ov = s.get("overload")
+    if ov:
+        print(f"OVERLOAD: {ov.get('shed_window_sets')} sets shed in the last "
+              f"{ov.get('window_s')}s; queue {ov.get('queue_depth_jobs')} jobs "
+              f"/ {ov.get('pending_sets')} sets; "
+              f"backpressure={'on' if ov.get('backpressure') else 'off'}")
+        if ov.get("dropped_by_lane"):
+            for lane, n in sorted(ov["dropped_by_lane"].items()):
+                print(f"  shed lane {lane:15s} {n} sets")
+        if ov.get("dropped_by_reason"):
+            for reason, n in sorted(ov["dropped_by_reason"].items()):
+                print(f"  shed reason {reason:13s} {n} sets")
     if s["stalled"]:
         print("STALLED batches:")
         for e in s["stalled"]:
+            dl = e.get("deadline_s")
+            worth = "" if dl is None else (
+                f" deadline_headroom={dl}s" + (" (EXPIRED)" if dl < 0 else "")
+            )
             print(f"  cid={e['cid']} device={e['device']} bucket={e['bucket']} "
-                  f"sets={e['sets']} age={e['age_s']}s")
+                  f"sets={e['sets']} age={e['age_s']}s{worth}")
     print(f"in flight at dump: {s['inflight_total']} "
           f"(per device: {s['inflight_per_device'] or '{}'})")
     for e in s["last_errors"]:
