@@ -33,6 +33,7 @@ api/impl/beacon/ (genesis/headers/blocks/pool).  Routes implemented:
   GET  /eth/v1/lodestar/bls_stages  (BLS pipeline counters)
   GET  /eth/v1/lodestar/health      (aggregated operational health)
   GET  /eth/v1/lodestar/forensics   (on-demand diagnostic bundle)
+  GET  /eth/v1/lodestar/observatory (compile ledger + device telemetry)
 """
 
 from __future__ import annotations
@@ -259,6 +260,7 @@ class RestApiServer:
         # failure forensics: aggregated node health + on-demand bundle dump
         r("GET", "/eth/v1/lodestar/health", self._lodestar_health)
         r("GET", "/eth/v1/lodestar/forensics", self._forensics)
+        r("GET", "/eth/v1/lodestar/observatory", self._observatory)
 
     # -- node/peers + config namespaces ----------------------------------------
 
@@ -1091,6 +1093,24 @@ class RestApiServer:
             "bundles_written": RECORDER.bundles_written,
         }
         return (status, {"data": data}, "application/json")
+
+    def _observatory(self, pp, q, b):
+        """Performance-observatory snapshot (docs/observability.md
+        §Performance observatory): the compile ledger's per-entry
+        cold/warm_load/hit totals and the device sampler's HBM/busy view
+        — `curl .../observatory | jq .data.compile_ledger` answers "what
+        did startup pay" on a live node."""
+        from ..observatory import COMPILE_LEDGER, get_sampler
+        from ..observatory.latency import SLO_LATENCY_BUCKETS_S
+
+        sampler = get_sampler()
+        return {
+            "data": {
+                "compile_ledger": COMPILE_LEDGER.summary(),
+                "device_telemetry": sampler.snapshot() if sampler else None,
+                "latency_buckets_s": list(SLO_LATENCY_BUCKETS_S),
+            }
+        }
 
     def _forensics(self, pp, q, b):
         """On-demand diagnostic bundle ('what are you doing right now'

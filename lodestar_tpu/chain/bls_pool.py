@@ -446,7 +446,7 @@ class BlsBatchPool:
                     merged: List[SignatureSet] = []
                     batch_deadline: Optional[float] = None
                     for item, fut, t_enq, lane, deadline in drained:
-                        jobs.append((item, fut, lane))
+                        jobs.append((item, fut, lane, t_enq))
                         merged.extend(item)
                         if deadline is not None:
                             batch_deadline = (
@@ -454,9 +454,13 @@ class BlsBatchPool:
                                 else min(batch_deadline, deadline)
                             )
                         if self.metrics:
+                            # deprecated laneless alias kept one release
                             self.metrics.bls_pool_queue_wait_seconds.observe(
                                 now - t_enq
                             )
+                            self.metrics.bls_queue_wait_seconds.labels(
+                                lane=_lane_name(lane)
+                            ).observe(now - t_enq)
                         if TRACER.enabled:
                             TRACER.add_span(
                                 "bls.queue_wait", "queue",
@@ -554,15 +558,20 @@ class BlsBatchPool:
                     self.metrics.bls_pool_inflight_depth.set(len(inflight))
                 if ok:
                     self.batch_sets_success += len(merged)
-                    for _item, fut, _lane in jobs:
+                    for item, fut, lane, t_enq in jobs:
+                        # e2e observes DELIVERED verdicts only: a pusher
+                        # cancelled mid-flight (fut already done) never
+                        # received one, and the retry path below skips
+                        # those too — the histogram must agree
                         if not fut.done():
                             fut.set_result(True)
+                            self._observe_e2e(lane, t_done - t_enq)
                     continue
                 # merged batch failed: re-verify each job individually so
                 # innocent jobs still succeed (worker.ts:78-88)
                 self.batch_retries += 1
                 logger.debug("merged batch of %d jobs failed; retrying individually", len(jobs))
-                for item, fut, lane in jobs:
+                for item, fut, lane, t_enq in jobs:
                     if fut.done():
                         continue
                     if self._closed:
@@ -583,6 +592,7 @@ class BlsBatchPool:
                         continue
                     if not fut.done():  # ditto — set on a cancelled future
                         fut.set_result(one)  # raises and would kill the flusher
+                        self._observe_e2e(lane, time.monotonic() - t_enq)
         finally:
             self._flushing = False
             self._update_backpressure()
@@ -591,12 +601,22 @@ class BlsBatchPool:
             if len(self._queue):
                 self._buffered_sets_changed()
 
+    def _observe_e2e(self, lane, seconds: float) -> None:
+        """Histogram-grade end-to-end verify latency (enqueue -> verdict
+        resolved) per QoS lane — the /metrics twin of the firehose
+        report's e2e percentiles (same SLO bucket ladder)."""
+        if self.metrics:
+            self.metrics.bls_e2e_verify_seconds.labels(
+                lane=_lane_name(lane)
+            ).observe(seconds)
+
     def _publish_flush_metrics(self, busy: float, wall: float, sets_done: int = 0) -> None:
         """End-of-flush snapshots: the overlap ratio this flush achieved,
         the previously-orphaned verifier stage_seconds / pool
         inflight_peak counters (ISSUE 2 satellite 1), and the north-star
-        per-chip throughput of this flush (sets resolved / wall /
-        n_devices)."""
+        throughput of this flush — per chip AND whole-mesh (ISSUE 7
+        satellite 2: roadmap item 1's success metric needs the mesh
+        headline to exist before the sharded kernel lands)."""
         if not self.metrics:
             return
         self.metrics.bls_pool_inflight_depth.set(0)
@@ -606,6 +626,7 @@ class BlsBatchPool:
         if sets_done and wall > 0:
             n_dev = max(1, getattr(self.verifier, "n_devices", 1))
             self.metrics.bls_sets_per_sec_per_chip.set(sets_done / wall / n_dev)
+            self.metrics.bls_sets_per_sec_mesh.set(sets_done / wall)
         stage_seconds = getattr(self.verifier, "stage_seconds", None)
         if stage_seconds:
             for stage, secs in stage_seconds.items():

@@ -188,6 +188,16 @@ def build_parser() -> argparse.ArgumentParser:
             "ingestable object per line stamped with the batch "
             "correlation id (default: text, or $LODESTAR_LOG_FORMAT)",
         )
+        p.add_argument(
+            "--telemetry-interval-s", type=float, default=5.0,
+            help="device telemetry sampler period: per-device HBM "
+            "(Device.memory_stats) and busy-ratio gauges + periodic "
+            "journal events, published at /metrics and "
+            "GET /eth/v1/lodestar/observatory (0 disables; runs only "
+            "with the TPU verifier — it never initializes a JAX "
+            "backend on its own; docs/observability.md §Performance "
+            "observatory)",
+        )
 
     dev = sub.add_parser("dev", help="single-process interop chain (cmds/dev)")
     common(dev)
@@ -356,6 +366,28 @@ def _configure_forensics(args, metrics=None, pool=None) -> None:
     logger.info("flight recorder on: bundles -> %s (watchdog %s)",
                 RECORDER.dir,
                 f"{deadline:.1f}s" if deadline > 0 else "off")
+    _configure_observatory(args, metrics=metrics, pool=pool)
+
+
+def _configure_observatory(args, metrics=None, pool=None) -> None:
+    """Performance-observatory bring-up: hand the compile ledger its
+    metrics registry and start the device telemetry sampler — but only
+    when the verifier actually drives devices (TpuBlsVerifier): the
+    sampler resolves jax.devices() lazily, and a native/python run must
+    not initialize a JAX backend just to read zero telemetry."""
+    from .observatory import COMPILE_LEDGER, start_sampler
+
+    if metrics is not None:
+        COMPILE_LEDGER.configure(metrics=metrics)
+    interval = getattr(args, "telemetry_interval_s", 5.0)
+    verifier = getattr(pool, "verifier", None)
+    if interval and interval > 0 and hasattr(verifier, "_executors"):
+        devices = [ex.device for ex in verifier._executors if ex.device is not None]
+        start_sampler(
+            interval_s=interval, metrics=metrics,
+            devices=devices or None,
+        )
+        logger.info("device telemetry sampler on (every %.1fs)", interval)
 
 
 def _dump_trace(path) -> None:

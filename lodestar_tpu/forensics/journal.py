@@ -173,6 +173,27 @@ _JAX_LISTENER_LOCK = threading.Lock()
 #: jits would otherwise flood the ring
 JAX_COMPILE_MIN_SECS = 0.05
 
+#: downstream consumers of the raw monitoring stream (the observatory's
+#: compile ledger registers here): called as fn(event, duration) for
+#: duration events and fn(event, None) for plain events, unfiltered —
+#: sinks do their own thresholding/classification
+_COMPILE_SINKS: List[Any] = []
+
+
+def add_compile_sink(fn) -> None:
+    """Register a callable on the journal's jax.monitoring feed
+    (idempotent per function object)."""
+    if fn not in _COMPILE_SINKS:
+        _COMPILE_SINKS.append(fn)
+
+
+def _notify_sinks(event: str, duration: Optional[float]) -> None:
+    for fn in _COMPILE_SINKS:
+        try:
+            fn(event, duration)
+        except Exception:  # a broken sink must never break compilation
+            pass
+
 
 def install_jax_monitoring(journal: EventJournal = JOURNAL) -> bool:
     """Register a ``jax.monitoring`` duration listener that journals
@@ -194,6 +215,7 @@ def install_jax_monitoring(journal: EventJournal = JOURNAL) -> bool:
 
         def _on_duration(event: str, duration: float = 0.0, **kw: Any) -> None:
             try:
+                _notify_sinks(event, duration)
                 if "compile" in event and duration >= JAX_COMPILE_MIN_SECS:
                     journal.record(
                         "jax.compile", event=event, seconds=round(duration, 3)
@@ -201,9 +223,27 @@ def install_jax_monitoring(journal: EventJournal = JOURNAL) -> bool:
             except Exception:
                 pass
 
+        def _on_event(event: str, **kw: Any) -> None:
+            # plain (durationless) events: the persistent-cache hit/miss
+            # markers the compile ledger needs to tell a warm load from a
+            # cold compile
+            try:
+                _notify_sinks(event, None)
+            except Exception:
+                pass
+
         try:
             jax.monitoring.register_event_duration_secs_listener(_on_duration)
         except Exception:
             return False
+        # registration is not transactional: once the duration listener is
+        # live we MUST mark installed (a retry would double-register it and
+        # every compile event would be delivered twice).  The plain-event
+        # listener is best-effort on top — without it the ledger just
+        # loses the cache-hit markers, never correctness of durations.
         _JAX_LISTENER_INSTALLED = True
+        try:
+            jax.monitoring.register_event_listener(_on_event)
+        except Exception:
+            pass
         return True

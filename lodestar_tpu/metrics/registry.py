@@ -9,6 +9,8 @@ from __future__ import annotations
 
 from typing import Dict, Optional, Sequence
 
+from ..observatory.latency import COMPILE_BUCKETS_S, SLO_LATENCY_BUCKETS_S
+
 try:  # prometheus_client is present in the image; gate anyway
     from prometheus_client import (
         CollectorRegistry,
@@ -127,7 +129,9 @@ class Metrics:
         # span-derived pipeline observability (docs/observability.md)
         self.bls_pool_queue_wait_seconds = r.histogram(
             "lodestar_bls_pool_queue_wait_seconds",
-            "time a job sat in the pool buffer before its batch was drained",
+            "DEPRECATED (one release, round 11): laneless queue-wait "
+            "histogram on ad-hoc buckets — use bls_queue_wait_seconds "
+            "(per lane, SLO-ladder buckets)",
             buckets=(0.0005, 0.001, 0.005, 0.01, 0.02, 0.05, 0.1, 0.5, 1),
         )
         self.bls_pool_overlap_ratio = r.gauge(
@@ -141,8 +145,9 @@ class Metrics:
         )
         self.bls_verifier_stage_seconds = r.gauge(
             "lodestar_bls_verifier_stage_seconds",
-            "cumulative wall seconds the verifier spent per stage "
-            "(TpuBlsVerifier.stage_seconds snapshot, updated on flush)",
+            "DEPRECATED (one release, round 11): cumulative wall seconds "
+            "per stage as a last-write gauge snapshot at flush — use the "
+            "per-dispatch histogram bls_verifier_stage_duration_seconds",
             labels=("stage",),
         )
         # multi-chip executor pool + pack-side caches (round 8)
@@ -192,6 +197,62 @@ class Metrics:
             "pending verification jobs per QoS lane "
             "(block_proposal/aggregate/unaggregated/sync_committee)",
             labels=("lane",),
+        )
+        # performance observatory (round 11, docs/observability.md
+        # §Performance observatory)
+        self.bls_queue_wait_seconds = r.histogram(
+            "lodestar_bls_queue_wait_seconds",
+            "per-job pool buffer wait by QoS lane, on the firehose SLO "
+            "bucket ladder — p50/p99 here, in firehose reports, and in "
+            "bls.queue_wait spans agree to one bucket "
+            "(replaces the deprecated laneless bls_pool_queue_wait_seconds)",
+            buckets=SLO_LATENCY_BUCKETS_S,
+            labels=("lane",),
+        )
+        self.bls_e2e_verify_seconds = r.histogram(
+            "lodestar_bls_e2e_verify_seconds",
+            "end-to-end verify latency by QoS lane: job enqueue -> "
+            "verdict resolved (drops excluded — they land in "
+            "bls_pool_dropped_total), SLO-ladder buckets",
+            buckets=SLO_LATENCY_BUCKETS_S,
+            labels=("lane",),
+        )
+        self.bls_verifier_stage_duration_seconds = r.histogram(
+            "lodestar_bls_verifier_stage_duration_seconds",
+            "per-call verifier stage duration (pack/dispatch/final_exp) — "
+            "the histogram the deprecated bls_verifier_stage_seconds gauge "
+            "snapshot could never be",
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5),
+            labels=("stage",),
+        )
+        self.bls_compile_seconds = r.histogram(
+            "lodestar_bls_compile_seconds",
+            "program materialization cost by entry and kind: cold = real "
+            "XLA/Mosaic backend compile, warm_load = persistent-cache "
+            "load, hit = already live in-process (compile ledger, "
+            "persisted in .jax_cache/compile_ledger.json)",
+            buckets=COMPILE_BUCKETS_S,
+            labels=("entry", "kind"),
+        )
+        self.bls_device_hbm_bytes = r.gauge(
+            "lodestar_bls_device_hbm_bytes",
+            "per-device memory from Device.memory_stats() by kind "
+            "(bytes_in_use/peak_bytes_in_use/bytes_limit/...), sampled by "
+            "the observatory device sampler",
+            labels=("device", "kind"),
+        )
+        self.bls_device_busy_ratio = r.gauge(
+            "lodestar_bls_device_busy_ratio",
+            "fraction of recent sampler ticks each device had >= 1 "
+            "unresolved batch in flight — the is-the-mesh-actually-full "
+            "signal roadmap item 1 is judged by",
+            labels=("device",),
+        )
+        self.bls_sets_per_sec_mesh = r.gauge(
+            "lodestar_bls_sets_per_sec_mesh",
+            "whole-mesh signature sets resolved per second in the last "
+            "pool flush (sets/wall, NOT divided by device count) — the "
+            "headline the sharded-kernel roadmap item is measured against",
         )
         # flight recorder & failure forensics (round 9)
         self.bls_watchdog_stalls_total = r.counter(

@@ -290,6 +290,11 @@ async def run_firehose(
         "submitted_sets": submitted_sets,
         "verified_sets": verified_sets,
         "achieved_sets_per_s": round(verified_sets / wall_s, 1) if wall_s else None,
+        # whole-mesh headline (ISSUE 7 satellite 2): what the NODE
+        # sustained across every device, the per-chip twin of which is
+        # bls_sets_per_sec_per_chip — named so the run ledger and the
+        # roadmap item 1 success metric read one key
+        "bls_sig_sets_per_s": round(verified_sets / wall_s, 1) if wall_s else None,
         "queue_wait": _lat_stats(queue_wait_ms),
         "e2e": _lat_stats(e2e_all),
         "e2e_by_duty": {d: _lat_stats(lat) for d, lat in sorted(by_duty.items())},

@@ -1,0 +1,175 @@
+#!/usr/bin/env python3
+"""Tier-1 wall-time budget report: who is eating the 870 s cap.
+
+Reads the run ledger ``tests/conftest.py`` appends to
+``.jax_cache/tier1_timings.json`` (per-test setup+call+teardown wall
+plus per-test compile-guard event counts, last 8 runs kept) and prints:
+
+- the suite wall-time trend against the cap and the margin left;
+- the top-10 movers vs the previous run (intersection of node ids — a
+  test that got 13 s slower shows up here BEFORE the whole suite trips
+  rc=124, which is how the <35 s-margin problem stays visible);
+- the top-10 slowest tests of the latest run and which tests triggered
+  expensive compile/cache-load events.
+
+Usage:
+    python tools/tier1_budget.py                 # report
+    python tools/tier1_budget.py --json
+    python tools/tier1_budget.py --fail-margin 35   # exit 1 when the
+                                  # latest full run left < 35 s of cap
+
+A run with far fewer tests than its predecessor (a `-k` subset) is
+reported but never gates — its wall time says nothing about the cap.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+_REPO_DEFAULT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO_DEFAULT)
+
+from lodestar_tpu.observatory.run_ledger import (  # noqa: E402
+    TIER1_FULL_RUN_MIN_TESTS,
+)
+
+DEFAULT_CAP_S = 870.0
+
+
+def load_ledger(repo: str) -> List[Dict[str, Any]]:
+    path = os.path.join(repo, ".jax_cache", "tier1_timings.json")
+    try:
+        with open(path) as f:
+            return json.load(f).get("runs", [])
+    except (OSError, ValueError):
+        return []
+
+
+def movers(prev: Dict[str, float], last: Dict[str, float],
+           top: int = 10) -> List[Dict[str, Any]]:
+    """Largest absolute per-test deltas over the shared node ids."""
+    shared = set(prev) & set(last)
+    deltas = [
+        {
+            "test": nodeid,
+            "prev_s": prev[nodeid],
+            "last_s": last[nodeid],
+            "delta_s": round(last[nodeid] - prev[nodeid], 3),
+        }
+        for nodeid in shared
+    ]
+    deltas.sort(key=lambda d: -abs(d["delta_s"]))
+    return deltas[:top]
+
+
+def analyze(repo: str, cap_s: float = DEFAULT_CAP_S) -> Dict[str, Any]:
+    runs = load_ledger(repo)
+    out: Dict[str, Any] = {
+        "cap_s": cap_s,
+        "runs": [
+            {"wall_s": r.get("wall_s"), "n_tests": r.get("n_tests"),
+             "exitstatus": r.get("exitstatus"),
+             "compile_events": r.get("compile_events"),
+             "compile_events_s": r.get("compile_events_s")}
+            for r in runs
+        ],
+    }
+    if not runs:
+        return out
+    last = runs[-1]
+    out["last_wall_s"] = last.get("wall_s")
+    out["margin_s"] = (
+        round(cap_s - last["wall_s"], 1) if last.get("wall_s") is not None else None
+    )
+    # "full" is absolute (run_ledger.TIER1_FULL_RUN_MIN_TESTS), never
+    # relative to the previous entry: two identical `pytest -k` subsets
+    # must not validate each other into gating the cap, and the very
+    # first ledger entry gets no benefit of the doubt either
+    out["is_full_run"] = last.get("n_tests", 0) >= TIER1_FULL_RUN_MIN_TESTS
+    prev_full = None
+    for r in reversed(runs[:-1]):
+        if r.get("n_tests", 0) >= TIER1_FULL_RUN_MIN_TESTS:
+            prev_full = r
+            break
+    if prev_full is not None:
+        out["movers"] = movers(prev_full.get("tests", {}), last.get("tests", {}))
+        if last.get("wall_s") and prev_full.get("wall_s"):
+            out["wall_delta_s"] = round(last["wall_s"] - prev_full["wall_s"], 1)
+    slowest = sorted(
+        last.get("tests", {}).items(), key=lambda kv: -kv[1]
+    )[:10]
+    out["slowest"] = [{"test": t, "seconds": s} for t, s in slowest]
+    out["compiling_tests"] = dict(
+        sorted(last.get("test_compiles", {}).items(), key=lambda kv: -kv[1])[:10]
+    )
+    return out
+
+
+def render(report: Dict[str, Any]) -> str:
+    lines = [f"tier-1 budget (cap {report['cap_s']:.0f}s)"]
+    if not report["runs"]:
+        lines.append("  no recorded runs — run the suite once to seed the ledger")
+        return "\n".join(lines)
+    walls = " -> ".join(
+        f"{r['wall_s']}s({r['n_tests']}t,rc{r['exitstatus']})"
+        for r in report["runs"]
+    )
+    lines.append(f"  runs: {walls}")
+    if report.get("margin_s") is not None:
+        flag = "  ⚠" if report["margin_s"] < 60 else ""
+        lines.append(
+            f"  latest wall {report['last_wall_s']}s — margin "
+            f"{report['margin_s']}s{flag}"
+            + ("" if report.get("is_full_run") else "  [partial run: not gating]")
+        )
+    if report.get("wall_delta_s") is not None:
+        lines.append(f"  wall delta vs previous full run: {report['wall_delta_s']:+}s")
+    if report.get("movers"):
+        lines.append("  top movers vs previous run:")
+        for m in report["movers"]:
+            lines.append(
+                f"    {m['delta_s']:+8.2f}s  {m['test']}  "
+                f"({m['prev_s']} -> {m['last_s']})"
+            )
+    if report.get("slowest"):
+        lines.append("  slowest tests (latest run):")
+        for s in report["slowest"]:
+            lines.append(f"    {s['seconds']:8.2f}s  {s['test']}")
+    if report.get("compiling_tests"):
+        lines.append("  compile-guard events by test (latest run):")
+        for t, n in report["compiling_tests"].items():
+            lines.append(f"    {n:3d}  {t}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--repo", default=_REPO_DEFAULT)
+    ap.add_argument("--cap", type=float, default=DEFAULT_CAP_S)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--fail-margin", type=float, default=None, metavar="S",
+                    help="exit 1 when the latest FULL run left less than "
+                    "this many seconds of cap margin")
+    args = ap.parse_args(argv)
+    report = analyze(args.repo, cap_s=args.cap)
+    print(json.dumps(report, indent=1) if args.json else render(report))
+    if (
+        args.fail_margin is not None
+        and report.get("margin_s") is not None
+        and report.get("is_full_run")
+        and report["margin_s"] < args.fail_margin
+    ):
+        print(
+            f"tier-1 margin {report['margin_s']}s < {args.fail_margin}s",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
