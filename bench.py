@@ -766,6 +766,59 @@ def bench_wedge(seconds: float = 3600.0):
     time.sleep(seconds)
 
 
+def bench_chaos(time_budget_s: float = 240.0):
+    """Chaos campaign stage (docs/chaos.md): every fault class against a
+    live stub pool — device loss/wedge, the fused→XLA→native compile
+    ladder, cache corruption, a SIGKILLed grandchild, bundle-IO faults —
+    publishing the ROADMAP item-5 guarantee numbers: zero undiagnosable
+    deaths (every bundle inspect_bundle-valid), ``verdicts_lost`` (must
+    be 0), ``time_to_quarantine_s`` / ``time_to_recover_s``, and the
+    post-fault throughput recovery ratio.  Stub device programs only —
+    no XLA work, no device contention with the throughput stages.
+
+    Runs the campaign CLI in a fresh grandchild: this stage child has
+    already imported jax WITHOUT the forced virtual-device flag (the
+    module-level cache configure), and the stub executor pool needs the
+    8 virtual CPU devices — which must be set before jax ever imports."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "chaos_campaign.py"),
+         "--seed", os.environ.get("BENCH_CHAOS_SEED", "0"), "--json"],
+        capture_output=True, text=True, env=env,
+        timeout=max(30.0, time_budget_s - 30.0),
+    )
+    try:
+        # the report is the final JSON object on stdout (check_trace's
+        # per-file OK lines precede it)
+        report = json.loads(proc.stdout[proc.stdout.index("{"):])
+    except ValueError:
+        raise RuntimeError(
+            f"chaos campaign produced no report (rc={proc.returncode}): "
+            f"{proc.stderr[-500:]}"
+        )
+    return {
+        "ok": report["ok"],
+        "seed": report["seed"],
+        "verdicts_lost": report["verdicts_lost"],
+        "bundles_validated": report["bundles_validated"],
+        "time_to_quarantine_s": report["time_to_quarantine_s"],
+        "time_to_recover_s": report["time_to_recover_s"],
+        "throughput_recovery_ratio": report["throughput_recovery_ratio"],
+        "scenarios": {
+            name: s.get("ok") for name, s in report["scenarios"].items()
+        },
+        "failures": report["failures"] or None,
+    }
+
+
 def _stage_child(q, fn_name, args):
     """Subprocess entry: run one benchmark stage and ship the result (or
     the error repr) back over the queue.  A salvage heartbeat snapshots
@@ -775,6 +828,15 @@ def _stage_child(q, fn_name, args):
         hb = salvage.start_heartbeat(fn_name)
     except Exception:  # scratch-disk trouble must not fail the stage
         hb = None
+    try:
+        # chaos activation seam: an armed LODESTAR_TPU_CHAOS_PLAN env var
+        # injects faults into ANY bench stage (docs/chaos.md); a no-op
+        # (one env read) when unset
+        from lodestar_tpu.chaos import install_from_env
+
+        install_from_env()
+    except Exception:
+        pass
     try:
         fn = globals()[fn_name]
         q.put(("ok", fn(*args)))
@@ -904,6 +966,12 @@ def main() -> None:
     firehose, err = _stage("bench_firehose", (), 420)
     if err:
         errors["firehose"] = err
+    # chaos campaign (ISSUE 8): zero undiagnosable deaths under injected
+    # faults + self-healing pool recovery numbers — stub programs only,
+    # so it contends with nothing
+    chaos, err = _stage("bench_chaos", (), 300)
+    if err:
+        errors["chaos"] = err
     # cold start (ISSUE 7): process start -> first verified batch, warm
     # (repo cache) and cold (empty cache) variants in fresh grandchildren —
     # the ROADMAP item 4 baseline.  Runs LAST among device stages so its
@@ -971,6 +1039,7 @@ def main() -> None:
                     "multichip": multichip,
                     "scale_250k": scale,
                     "firehose": firehose,
+                    "chaos": chaos,
                     "cold_start": cold_start or None,
                     "perf_deltas": perf_deltas,
                     "lint": {

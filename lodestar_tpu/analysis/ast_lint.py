@@ -24,6 +24,10 @@ Rules (rationale + incident history in docs/static_analysis.md):
   held across a suspension point blocks every other thread touching that
   lock for the awaited duration — and deadlocks if the awaited task needs
   the lock.
+- ``bls-silent-except``     ``except`` arms in ``crypto/bls/`` or
+  ``chain/bls_pool.py`` that neither journal, count, nor re-raise.
+  Silent swallows on the dispatch path hide exactly the faults the chaos
+  plane injects (lost devices, failed compiles, dropped verdicts).
 - ``metrics-coverage``      every metric registered in
   ``metrics/registry.py`` must be referenced by a dashboard or docs
   (absorbed from tools/check_metrics_coverage.py).
@@ -221,6 +225,88 @@ class AwaitHoldingLockChecker(Checker):
 
 
 # ---------------------------------------------------------------------------
+# bls-silent-except
+# ---------------------------------------------------------------------------
+
+#: call terminal names that count as journaling / counting / propagating:
+#: journal (JOURNAL.record), any logger method (WARNING+ mirrors into the
+#: journal via utils/logger.JournalHandler), metric increments, and
+#: exception propagation onto a future
+_EXCEPT_HANDLED_CALLS = {
+    "record", "debug", "info", "warning", "error", "exception", "critical",
+    "log", "inc", "set_exception",
+}
+#: substrings marking a dedicated accounting helper (``_pack_reject``,
+#: ``_count_drop``, ``_degrade``, ``_record_executor_failure``,
+#: ``_native_fallback_verdict``, ``maybe_raise`` re-injection, ...)
+_EXCEPT_HANDLED_SUBSTRINGS = (
+    "reject", "drop", "count", "degrade", "record", "fallback", "requeue",
+)
+
+
+def _bls_scope(path: str) -> bool:
+    parts = os.path.normpath(path).split(os.sep)
+    if "crypto" in parts and "bls" in parts:
+        return True
+    return parts[-2:] == ["chain", "bls_pool.py"]
+
+
+class BlsSilentExceptChecker(Checker):
+    """Every ``except`` arm on the BLS verification path must journal,
+    count, or re-raise.  A silent swallow here turns a lost device, a
+    failed compile, or a dropped verdict into an invisible non-event —
+    exactly the faults the chaos plane (lodestar_tpu/chaos) injects to
+    prove diagnosability.  Scope: ``crypto/bls/`` and
+    ``chain/bls_pool.py`` (the dispatch path proper; the rest of the tree
+    has its own disciplines)."""
+
+    rule = "bls-silent-except"
+    description = "except arm on the BLS path swallows without evidence"
+
+    def _handled(self, handler: ast.ExceptHandler) -> bool:
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.AugAssign):
+                return True  # counter += n
+            if isinstance(node, ast.Call):
+                name = _terminal_name(node.func)
+                if name is None:
+                    continue
+                low = name.lower()
+                if name in _EXCEPT_HANDLED_CALLS or any(
+                    sub in low for sub in _EXCEPT_HANDLED_SUBSTRINGS
+                ):
+                    return True
+        return False
+
+    def check(self, path: str, tree: ast.AST, source: str) -> List[Violation]:
+        if not _bls_scope(path):
+            return []
+        out: List[Violation] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if self._handled(handler):
+                    continue
+                exc = (
+                    ast.unparse(handler.type) if handler.type is not None
+                    else "<bare>"
+                )
+                out.append(
+                    Violation(
+                        self.rule, path, handler.lineno,
+                        f"except {exc} swallows without journaling, "
+                        f"counting, or re-raising — a fault on the BLS "
+                        f"path must leave evidence (JOURNAL.record / "
+                        f"logger.* / a counter / raise)",
+                    )
+                )
+        return out
+
+
+# ---------------------------------------------------------------------------
 # metrics-coverage (absorbed from tools/check_metrics_coverage.py)
 # ---------------------------------------------------------------------------
 
@@ -265,6 +351,7 @@ DEFAULT_CHECKERS = (
     AsyncBlockingSyncChecker,
     TracingWallclockChecker,
     AwaitHoldingLockChecker,
+    BlsSilentExceptChecker,
 )
 
 _REGISTRY_REL = os.path.join("lodestar_tpu", "metrics", "registry.py")

@@ -283,6 +283,8 @@ VERIFIER_GUARDED_ATTRS = frozenset(
         "pack_rejected",
         "pack_cache_hits",
         "pack_cache_misses",
+        "batches_requeued",
+        "native_fallbacks",
     }
 )
 

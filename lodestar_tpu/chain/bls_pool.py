@@ -85,7 +85,8 @@ logger = get_logger("bls-pool")
 def _lane_name(lane) -> str:
     try:
         return SignatureSetPriority(lane).name.lower()
-    except ValueError:
+    except ValueError:  # lint: disable=bls-silent-except
+        # label-formatting fallback for out-of-enum lanes, not a fault path
         return str(lane)
 
 
@@ -156,7 +157,8 @@ class BlsBatchPool:
                 self._accepts_deadline = "deadline" in inspect.signature(
                     verifier.verify_signature_sets_async
                 ).parameters
-            except (TypeError, ValueError):
+            except (TypeError, ValueError):  # lint: disable=bls-silent-except
+                # construction-time capability probe, not a fault path
                 self._accepts_deadline = False
 
     async def _verify_job(self, sets: List[SignatureSet]) -> bool:

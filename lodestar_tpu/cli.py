@@ -151,6 +151,17 @@ def build_parser() -> argparse.ArgumentParser:
             "pubkeys and committee aggregates repeat epoch-to-epoch)",
         )
         p.add_argument(
+            "--bls-quarantine-threshold", type=int, default=2,
+            help="consecutive verdict/dispatch failures on one device "
+            "executor before it is quarantined out of the placement "
+            "rotation (docs/chaos.md self-healing pool)",
+        )
+        p.add_argument(
+            "--bls-quarantine-backoff-s", type=float, default=1.0,
+            help="first quarantine duration; a failed re-admission probe "
+            "doubles it (capped at 60s), a successful probe resets it",
+        )
+        p.add_argument(
             "--trace-dump", default=None, metavar="PATH",
             help="enable hot-path span tracing and write a Chrome trace-"
             "event JSON (open in Perfetto / chrome://tracing) to PATH on "
@@ -456,6 +467,8 @@ def _make_verifier(args):
         v = TpuBlsVerifier(
             buckets=buckets, fused=fused, devices=devices,
             point_cache_size=getattr(args, "bls_point_cache_size", 8192),
+            quarantine_threshold=getattr(args, "bls_quarantine_threshold", 2),
+            quarantine_backoff_s=getattr(args, "bls_quarantine_backoff_s", 1.0),
         )
         warm = getattr(args, "bls_warmup", "background")
         profile_dir = getattr(args, "jax_profile", None)

@@ -40,10 +40,11 @@ import os
 import secrets
 import threading
 import time
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ...chaos import CHAOS, DeviceLostError
 from ...forensics.journal import JOURNAL, install_jax_monitoring
 from ...forensics.watchdog import INFLIGHT
 from ...observatory.compile_ledger import COMPILE_LEDGER
@@ -149,17 +150,25 @@ class PendingVerdict:
     Construction never blocks: the device work is already enqueued (jax
     dispatch is async) and ``result()`` performs the only synchronization
     — the device readback plus, on the split path, the host C final
-    exponentiation.  ``result()`` is idempotent (the verdict is cached).
+    exponentiation.  ``result()`` is idempotent (the verdict — or the
+    terminal failure — is cached).
 
     ``release`` is the scheduler's in-flight slot return: called exactly
-    once when the first ``result()`` completes, so the least-loaded
-    placement sees the device free again."""
+    once when the first ``result()`` completes — success OR raise — so
+    the least-loaded placement sees the device free again and the
+    in-flight table entry resolves.  A failed sync (device lost, wedge
+    turned error, injected fault) releases the slot FIRST, then hands the
+    batch to the verifier's recovery path, which re-dispatches the same
+    packed payload onto a surviving executor (``bls.requeue``) before
+    degrading to the host-native tier."""
 
     __slots__ = ("_verifier", "_f", "_ok", "_out", "_value", "_parts", "_release",
+                 "_packed", "_sets", "_executor", "_attempt", "_fault", "_exc",
                  "device", "deadline")
 
     def __init__(self, verifier=None, f=None, ok=None, out=None, value=None,
-                 parts=None, release=None, device=None, deadline=None):
+                 parts=None, release=None, device=None, deadline=None,
+                 packed=None, sets=None, executor=None, attempt=0, fault=None):
         self._verifier = verifier
         self._f = f
         self._ok = ok
@@ -167,6 +176,12 @@ class PendingVerdict:
         self._value = value
         self._parts = parts
         self._release = release
+        self._packed = packed      # the dispatched payload (requeue re-uses it)
+        self._sets = sets          # original sets (native-tier fallback input)
+        self._executor = executor  # DeviceExecutor the batch landed on
+        self._attempt = attempt    # requeue generation (0 = first placement)
+        self._fault = fault        # armed chaos FaultSpec riding this verdict
+        self._exc = None           # terminal failure, replayed on re-calls
         self.device = device  # executor name the batch landed on (None for chunked)
         self.deadline = deadline  # tightest job deadline riding this batch
 
@@ -174,35 +189,133 @@ class PendingVerdict:
         """True once the verdict is cached (no sync performed)."""
         return self._value is not None
 
+    def _release_once(self) -> None:
+        """The exactly-once slot return: idempotent, so the success
+        finally, the failure hand-off, and repeated result() calls can
+        all pass through without double-freeing an executor slot (which
+        would corrupt least-loaded placement) or double-resolving the
+        in-flight table entry."""
+        release, self._release = self._release, None
+        if release is not None:
+            release()
+
+    def _compute(self) -> bool:
+        """The sync itself (no caching, no release) — the one place an
+        injected device fault surfaces, exactly where a real one would."""
+        fault, self._fault = self._fault, None  # consume: never re-fires
+        if fault is not None:
+            if fault.seam == "device.wedge" and fault.wedge_s > 0:
+                # the wedge window: the batch ages in the in-flight table
+                # (the watchdog's evidence) before the loss surfaces
+                time.sleep(fault.wedge_s)
+            raise DeviceLostError(
+                fault.error or f"injected {fault.seam} on {self.device}"
+            )
+        if self._parts is not None:
+            results = [p.result() for p in self._parts]
+            return all(results)
+        if self._f is not None:
+            return self._verifier._host_final_exp_verdict(self._f, self._ok)
+        # fused on-device verdict: the bool() read is the sync; the
+        # span plays the final_exp role on this path's timeline
+        t0_ns = TRACER.now()
+        value = bool(self._out)
+        if TRACER.enabled:
+            TRACER.add_span(
+                "bls.final_exp", "bls", t0_ns,
+                cid=current_batch_id(), on_device=True,
+            )
+        return value
+
     def result(self) -> bool:
-        if self._value is None:
-            try:
-                if self._parts is not None:
-                    results = [p.result() for p in self._parts]
-                    self._value = all(results)
-                elif self._f is not None:
-                    self._value = self._verifier._host_final_exp_verdict(self._f, self._ok)
-                else:
-                    # fused on-device verdict: the bool() read is the sync; the
-                    # span plays the final_exp role on this path's timeline
-                    t0_ns = TRACER.now()
-                    self._value = bool(self._out)
-                    if TRACER.enabled:
-                        TRACER.add_span(
-                            "bls.final_exp", "bls", t0_ns,
-                            cid=current_batch_id(), on_device=True,
-                        )
-            finally:
-                release, self._release = self._release, None
-                if release is not None:
-                    release()
-        return self._value
+        if self._value is not None:
+            return self._value
+        if self._exc is not None:
+            raise self._exc
+        try:
+            value = self._compute()
+        except Exception as e:
+            # free the slot BEFORE recovery: the re-dispatch below must
+            # see this executor's in-flight count already decremented
+            self._release_once()
+            v = self._verifier
+            if v is not None and self._executor is not None:
+                try:
+                    self._value = v._recover_failed_batch(self, e)
+                    return self._value
+                except Exception as terminal:
+                    self._exc = terminal
+                    raise
+            self._exc = e
+            raise
+        else:
+            self._value = value
+            if self._verifier is not None and self._executor is not None:
+                self._verifier._record_executor_success(self._executor)
+            return value
+        finally:
+            self._release_once()
+
+
+# -- executor health (the self-healing pool, docs/chaos.md) -----------------
+#
+# Per-executor state machine driven by verdict outcomes:
+#
+#     healthy --failure--> suspect --(failures >= threshold)--> quarantined
+#        ^                    |                                     |
+#        |<----success--------+          (backoff expires)          v
+#        |<------------ probe success ------------------------- probing
+#                              probe failure: re-quarantined, backoff doubled
+#
+# A quarantined executor receives no placements until its backoff expires;
+# it is then re-admitted with ONE probe batch — success restores it to the
+# rotation (backoff reset), failure doubles the backoff and re-quarantines.
+# Numeric values are exported as lodestar_bls_device_health{device}.
+
+HEALTHY, SUSPECT, PROBING, QUARANTINED = (
+    "healthy", "suspect", "probing", "quarantined"
+)
+HEALTH_STATE_VALUES = {HEALTHY: 0, SUSPECT: 1, PROBING: 2, QUARANTINED: 3}
+
+
+class ExecutorHealth:
+    """Mutable health record of one DeviceExecutor.  All writes happen
+    under the verifier's ``_sched_lock`` (the same lock that owns the
+    in-flight counters the scheduler reads)."""
+
+    __slots__ = ("state", "failures", "quarantines", "quarantined_until",
+                 "backoff_s", "last_error", "changed_monotonic")
+
+    def __init__(self, backoff_s: float):
+        self.state = HEALTHY
+        self.failures = 0        # consecutive failures (reset on success)
+        self.quarantines = 0     # lifetime quarantine entries
+        self.quarantined_until = 0.0  # monotonic instant the backoff expires
+        self.backoff_s = backoff_s    # next quarantine duration (doubles)
+        self.last_error = None
+        self.changed_monotonic = 0.0
+
+    def snapshot(self, now: Optional[float] = None) -> Dict[str, object]:
+        if now is None:
+            now = time.monotonic()
+        return {
+            "state": self.state,
+            "failures": self.failures,
+            "quarantines": self.quarantines,
+            "backoff_s": round(self.backoff_s, 3),
+            "readmission_in_s": (
+                round(max(0.0, self.quarantined_until - now), 3)
+                if self.state == QUARANTINED else None
+            ),
+            "last_error": self.last_error,
+        }
 
 
 class DeviceExecutor:
     """One chip's slice of the verifier: its own compiled programs (keyed
     like the old single-device cache) plus an in-flight batch counter the
-    scheduler reads for least-loaded placement.
+    scheduler reads for least-loaded placement, and the health record the
+    self-healing pool steers around.
 
     Each executor's programs are plain single-device ``jax.jit(...,
     device=d)`` compilations — the fused Pallas kernels stay single-chip
@@ -210,9 +323,9 @@ class DeviceExecutor:
     runs on any device count because batches are never sharded, only
     placed."""
 
-    __slots__ = ("device", "index", "name", "inflight", "compiled")
+    __slots__ = ("device", "index", "name", "inflight", "compiled", "health")
 
-    def __init__(self, device=None, index: int = 0):
+    def __init__(self, device=None, index: int = 0, backoff_s: float = 1.0):
         self.device = device  # None = default backend device (unpinned jit)
         self.index = index
         self.name = (
@@ -220,6 +333,7 @@ class DeviceExecutor:
         )
         self.inflight = 0
         self.compiled = {}
+        self.health = ExecutorHealth(backoff_s)
 
 
 class TpuBlsVerifier:
@@ -268,6 +382,10 @@ class TpuBlsVerifier:
         fused: Optional[bool] = None,
         metrics=None,
         point_cache_size: int = 8192,
+        quarantine_threshold: int = 2,
+        quarantine_backoff_s: float = 1.0,
+        quarantine_backoff_max_s: float = 60.0,
+        native_verifier=None,
     ):
         self.buckets = tuple(sorted(buckets))
         self.platform = platform
@@ -278,15 +396,25 @@ class TpuBlsVerifier:
         # verifier never touches a JAX backend.
         self.fused = fused
         self.metrics = metrics
+        # self-healing pool knobs (docs/chaos.md): consecutive failures
+        # before quarantine, the first backoff, and the doubling cap
+        self.quarantine_threshold = max(1, quarantine_threshold)
+        self.quarantine_backoff_s = quarantine_backoff_s
+        self.quarantine_backoff_max_s = quarantine_backoff_max_s
+        # final rung of the degradation ladder: fused -> XLA -> this host
+        # verifier (FastBlsVerifier self-falls-back to the Python oracle);
+        # lazy so a healthy node never constructs it
+        self._native = native_verifier
         # one executor per device; a single default executor otherwise
         # (its device is resolved lazily at first jit so constructing a
         # verifier still never touches a JAX backend)
         if self.devices:
             self._executors = [
-                DeviceExecutor(d, i) for i, d in enumerate(self.devices)
+                DeviceExecutor(d, i, backoff_s=quarantine_backoff_s)
+                for i, d in enumerate(self.devices)
             ]
         else:
-            self._executors = [DeviceExecutor(None, 0)]
+            self._executors = [DeviceExecutor(None, 0, backoff_s=quarantine_backoff_s)]
         self._sched_lock = threading.Lock()
         self._rr = 0  # round-robin tie-break cursor
         self.point_cache = PointCache(point_cache_size)
@@ -306,7 +434,14 @@ class TpuBlsVerifier:
         self.pack_rejected = 0
         self.pack_cache_hits = 0
         self.pack_cache_misses = 0
+        self.batches_requeued = 0    # failed batches re-dispatched to survivors
+        self.native_fallbacks = 0    # verdicts served by the host-native tier
         self.stage_seconds = {"pack": 0.0, "dispatch": 0.0, "final_exp": 0.0, "warmup": 0.0}
+        # rate limit for the automatic diagnostic bundles the self-healing
+        # events write (one per reason per cooldown — a persistently sick
+        # fleet must not fill the scratch disk)
+        self._dump_cooldown_s = 60.0
+        self._last_dump_by_reason: Dict[str, float] = {}
 
     @property
     def n_devices(self) -> int:
@@ -321,6 +456,13 @@ class TpuBlsVerifier:
     def device_inflight(self):
         """Snapshot of per-device in-flight batch counts (debug API)."""
         return {ex.name: ex.inflight for ex in self._executors}
+
+    def executor_health(self):
+        """Per-executor health snapshot (diagnostic bundles, the REST
+        health endpoint, and the chaos campaign all read this)."""
+        now = time.monotonic()
+        with self._sched_lock:
+            return {ex.name: ex.health.snapshot(now) for ex in self._executors}
 
     # -- compilation cache ---------------------------------------------------
 
@@ -384,24 +526,68 @@ class TpuBlsVerifier:
 
     # -- scheduling -----------------------------------------------------------
 
-    def _acquire_executor(self) -> DeviceExecutor:
-        """Least-loaded placement with a rotating round-robin tie-break, so
-        equal-load devices are fed in rotation rather than always device 0.
-        The in-flight increment happens under the same lock as the pick —
-        concurrent dispatch threads can't double-book a device."""
+    def _eligible_locked(self, ex: DeviceExecutor, now: float) -> bool:
+        """Placement eligibility under ``_sched_lock``: healthy/suspect
+        executors always; a quarantined one only once its backoff expired
+        AND it is idle (the re-admission probe is ONE batch — a sick chip
+        must not get a pile of work to fail); a probing one only while
+        its probe batch is still unresolved elsewhere (idle again)."""
+        h = ex.health
+        if h.state in (HEALTHY, SUSPECT):
+            return True
+        if h.state == QUARANTINED:
+            return now >= h.quarantined_until and ex.inflight == 0
+        return ex.inflight == 0  # PROBING: one batch at a time
+
+    def _acquire_executor(self, exclude: Optional[DeviceExecutor] = None) -> DeviceExecutor:
+        """Least-loaded placement among HEALTHY executors with a rotating
+        round-robin tie-break, so equal-load devices are fed in rotation
+        rather than always device 0.  Quarantined executors are skipped
+        until their backoff expires, then re-admitted with one probe
+        batch (docs/chaos.md state machine).  ``exclude`` keeps a requeue
+        off the executor that just failed it.  The in-flight increment
+        happens under the same lock as the pick — concurrent dispatch
+        threads can't double-book a device."""
+        now = time.monotonic()
+        transitions = []
         with self._sched_lock:
             k = len(self._executors)
             if k == 1:
                 ex = self._executors[0]
             else:
-                start = self._rr
-                self._rr = (self._rr + 1) % k
-                ex = min(
-                    (self._executors[(start + i) % k] for i in range(k)),
-                    key=lambda e: e.inflight,
-                )
+                eligible = [
+                    e for e in self._executors
+                    if e is not exclude and self._eligible_locked(e, now)
+                ]
+                if not eligible:
+                    # every executor quarantined (or excluded): the node
+                    # must keep serving — place on the one whose
+                    # re-admission is soonest rather than deadlock
+                    pool_ = [e for e in self._executors if e is not exclude]
+                    ex = min(
+                        pool_ or self._executors,
+                        key=lambda e: e.health.quarantined_until,
+                    )
+                else:
+                    start = self._rr
+                    self._rr = (self._rr + 1) % k
+                    n_el = len(eligible)
+                    ex = min(
+                        (eligible[(start + i) % n_el] for i in range(n_el)),
+                        key=lambda e: e.inflight,
+                    )
+            h = ex.health
+            if h.state == QUARANTINED and now >= h.quarantined_until:
+                h.state = PROBING
+                h.changed_monotonic = now
+                transitions.append((ex, PROBING, h.failures, h.backoff_s))
             ex.inflight += 1
             inflight = ex.inflight
+        for t_ex, state, failures, backoff in transitions:
+            # journal outside the scheduler lock (leaf-lock discipline)
+            JOURNAL.record("bls.health", device=t_ex.name, state=state,
+                           failures=failures, backoff_s=round(backoff, 3))
+            self._set_health_metric(t_ex)
         if self.metrics:
             self.metrics.bls_device_inflight.labels(device=ex.name).set(inflight)
         return ex
@@ -412,6 +598,207 @@ class TpuBlsVerifier:
             inflight = ex.inflight
         if self.metrics:
             self.metrics.bls_device_inflight.labels(device=ex.name).set(inflight)
+
+    # -- executor health (the self-healing half of the chaos plane) -----------
+
+    def _set_health_metric(self, ex: DeviceExecutor) -> None:
+        if self.metrics:
+            self.metrics.bls_device_health.labels(device=ex.name).set(
+                HEALTH_STATE_VALUES.get(ex.health.state, 0)
+            )
+
+    def _record_executor_failure(self, ex: DeviceExecutor, error) -> None:
+        """One verdict/dispatch failure on ``ex``: healthy -> suspect on
+        the first, quarantined once ``quarantine_threshold`` consecutive
+        failures accumulate; a failed re-admission probe re-quarantines
+        with the backoff doubled (capped).  Entering quarantine writes
+        one rate-limited diagnostic bundle — a sick chip is a triage
+        event, not just a gauge."""
+        now = time.monotonic()
+        quarantined = False
+        with self._sched_lock:
+            h = ex.health
+            h.failures += 1
+            h.last_error = f"{type(error).__name__}: {error}"[:200]
+            if h.state == PROBING:
+                # failed probe: the chip is still sick — double the backoff
+                h.backoff_s = min(self.quarantine_backoff_max_s, h.backoff_s * 2)
+                h.state = QUARANTINED
+                h.quarantined_until = now + h.backoff_s
+                h.quarantines += 1
+                quarantined = True
+            elif h.failures >= self.quarantine_threshold and h.state != QUARANTINED:
+                h.state = QUARANTINED
+                h.quarantined_until = now + h.backoff_s
+                h.quarantines += 1
+                quarantined = True
+            elif h.state == HEALTHY:
+                h.state = SUSPECT
+            state, failures, backoff = h.state, h.failures, h.backoff_s
+            h.changed_monotonic = now
+        JOURNAL.record(
+            "bls.health", level="WARNING" if quarantined else "INFO",
+            device=ex.name, state=state, failures=failures,
+            backoff_s=round(backoff, 3), error=str(error)[:200],
+        )
+        self._set_health_metric(ex)
+        if quarantined:
+            logger.warning(
+                "executor %s quarantined after %d failure(s); probe in %.2fs (%s)",
+                ex.name, failures, backoff, error,
+            )
+            if self.metrics:
+                self.metrics.bls_device_quarantines_total.labels(
+                    device=ex.name
+                ).inc()
+            self._maybe_dump(
+                f"quarantine-{ex.name}", metric_reason="quarantine",
+                extra={"quarantine": {
+                    "device": ex.name, "failures": failures,
+                    "backoff_s": round(backoff, 3),
+                    "error": str(error)[:300],
+                    "health": self.executor_health(),
+                }},
+            )
+
+    def _record_executor_success(self, ex: DeviceExecutor) -> None:
+        """A verdict resolved on ``ex`` (True OR False — the device did
+        its job): reset the failure streak; a successful probe re-admits
+        the executor to the rotation with its backoff reset.
+
+        A QUARANTINED executor is NOT re-admitted here: a success
+        arriving in that state is a stale batch placed before the
+        quarantine decision (or a desperation placement while the whole
+        pool is sick), and the quarantine was earned by newer evidence —
+        re-admission goes through the backoff probe, nothing else."""
+        if ex.health.state == HEALTHY:
+            return  # hot path: one plain attribute read, no lock
+        with self._sched_lock:
+            h = ex.health
+            if h.state in (HEALTHY, QUARANTINED):
+                return
+            prev = h.state
+            h.state = HEALTHY
+            h.failures = 0
+            h.backoff_s = self.quarantine_backoff_s
+            h.quarantined_until = 0.0
+            h.changed_monotonic = time.monotonic()
+        JOURNAL.record(
+            "bls.health", device=ex.name, state=HEALTHY,
+            readmitted=prev in (PROBING, QUARANTINED),
+        )
+        self._set_health_metric(ex)
+        if prev in (PROBING, QUARANTINED):
+            logger.info("executor %s re-admitted (probe verdict ok)", ex.name)
+
+    def _maybe_dump(self, reason: str, extra=None, metric_reason=None):
+        """Best-effort, rate-limited diagnostic bundle (one per reason
+        per ``_dump_cooldown_s``)."""
+        now = time.monotonic()
+        with self._stats_lock:
+            last = self._last_dump_by_reason.get(reason, -1e18)
+            if now - last < self._dump_cooldown_s:
+                return None
+            self._last_dump_by_reason[reason] = now
+        try:
+            from ...forensics.recorder import RECORDER
+
+            return RECORDER.dump(reason, extra=extra, metric_reason=metric_reason)
+        except Exception as e:  # noqa: BLE001 — evidence is best-effort
+            JOURNAL.record("bls.dump_failed", level="WARNING", reason=reason,
+                           error=str(e)[:200])
+            return None
+
+    # -- degradation ladder: fused -> XLA -> host-native ----------------------
+
+    def _degrade(self, where: str, tier: str, bucket=None, device=None,
+                 error=None) -> None:
+        """One ladder hop: exactly one journal event and one
+        ``bls_degrade_total{where,tier}`` increment per hop (the
+        previously metrics-invisible ``bls.degrade`` evidence)."""
+        logger.warning("bls degrade -> %s tier (%s, bucket=%s, device=%s): %s",
+                       tier, where, bucket, device, error)
+        JOURNAL.record(
+            "bls.degrade", level="WARNING", where=where, tier=tier,
+            bucket=bucket, device=device,
+            error=str(error)[:300] if error is not None else None,
+        )
+        if self.metrics:
+            self.metrics.bls_degrade_total.labels(where=where, tier=tier).inc()
+
+    def _native_verifier(self):
+        """The ladder's last rung, constructed on first need: the native C
+        verifier (which itself falls back to the pure-Python oracle when
+        the toolchain is absent)."""
+        nv = self._native
+        if nv is None:
+            from .native_verifier import FastBlsVerifier
+
+            nv = self._native = FastBlsVerifier()
+        return nv
+
+    def _native_fallback_verdict(self, sets, where: str, error) -> bool:
+        """Every device tier failed for this batch: verify on the host so
+        the caller still gets a real verdict (never a silent False, never
+        a stranded future).  Writes one rate-limited bundle — a node
+        running on its native tier is an incident in progress."""
+        with self._stats_lock:
+            self.native_fallbacks += 1
+        self._degrade(where=where, tier="native", error=error)
+        self._maybe_dump("degrade-native", metric_reason="degrade",
+                         extra={"degrade": {
+                             "where": where, "tier": "native",
+                             "error": str(error)[:300],
+                             "health": self.executor_health(),
+                         }})
+        return self._native_verifier().verify_signature_sets(list(sets))
+
+    def _recover_failed_batch(self, pending: "PendingVerdict", exc) -> bool:
+        """A dispatched batch's sync raised (device lost, wedge turned
+        error, injected fault): record the failure against its executor,
+        then re-dispatch the SAME packed payload onto a surviving
+        executor (``bls.requeue`` — the batch's pack work is not re-paid
+        and its batchmates are not punished), walking further executors
+        if the replay fails too.  When no survivor is left (or the pool
+        has one device), degrade to the host-native tier so the verdict
+        still resolves.  Raises only when even the native tier is
+        impossible (no original sets to verify) — the pool's
+        retry-individually path then owns the failure."""
+        ex = pending._executor
+        self._record_executor_failure(ex, exc)
+        cid = current_batch_id()
+        packed, sets = pending._packed, pending._sets
+        attempt = pending._attempt
+        if packed is not None and self.n_devices > 1 and attempt + 1 < self.n_devices:
+            with self._stats_lock:
+                self.batches_requeued += 1
+            if self.metrics:
+                self.metrics.bls_batch_requeues_total.inc()
+            t0_ns = TRACER.now()
+            JOURNAL.record(
+                "bls.requeue", level="WARNING", cid=cid, from_device=ex.name,
+                attempt=attempt + 1, error=str(exc)[:200],
+            )
+            try:
+                replay = self.dispatch(
+                    packed, deadline=pending.deadline, sets=sets,
+                    _attempt=attempt + 1, _exclude=ex,
+                )
+            except Exception as e2:  # noqa: BLE001 — keep walking the ladder
+                JOURNAL.record("bls.requeue_failed", level="ERROR", cid=cid,
+                               error=str(e2)[:200])
+                if sets is not None:
+                    return self._native_fallback_verdict(
+                        sets, where="requeue", error=e2
+                    )
+                raise
+            if TRACER.enabled:
+                TRACER.add_span("bls.requeue", "bls", t0_ns, cid=cid,
+                                from_device=ex.name, to_device=replay.device)
+            return replay.result()
+        if sets is not None:
+            return self._native_fallback_verdict(sets, where="result", error=exc)
+        raise exc
 
     def _abstract_args(self, n: int):
         """ShapeDtypeStructs matching pack() output — AOT lowering inputs."""
@@ -455,6 +842,13 @@ class TpuBlsVerifier:
                     ex.compiled[key] = memo_fn
                     continue
                 try:
+                    # chaos seam: an injected compile failure surfaces
+                    # exactly where a real Mosaic/XLA one would
+                    if CHAOS.armed:
+                        CHAOS.maybe_raise(
+                            "bls.compile", where="warmup", device=ex.name,
+                            bucket=b, fused=key[2],
+                        )
                     # ledger attribution: the monitoring events this
                     # compile fires land on (entry, bucket, device) and
                     # classify as cold vs persistent-cache warm load
@@ -472,11 +866,8 @@ class TpuBlsVerifier:
                         b, ex.name, e,
                     )
                     if self.fused:
-                        logger.warning("degrading to XLA-graph kernels (fused=False)")
-                        JOURNAL.record(
-                            "bls.degrade", level="WARNING", where="warmup",
-                            bucket=b, device=ex.name, error=str(e)[:300],
-                        )
+                        self._degrade(where="warmup", tier="xla",
+                                      bucket=b, device=ex.name, error=e)
                         self.fused = False
                         with self._stats_lock:
                             self.fused_fallbacks += 1
@@ -593,19 +984,37 @@ class TpuBlsVerifier:
         packed = self.pack(sets)
         if packed is None:
             return PendingVerdict(value=False)  # malformed bytes / infinity
-        return self.dispatch(packed, deadline=deadline)
+        try:
+            return self.dispatch(packed, deadline=deadline, sets=list(sets))
+        except Exception as e:  # noqa: BLE001
+            # every device tier failed to even ENQUEUE this batch
+            # (fused and XLA program calls both raised): final rung of
+            # the degradation ladder — verify on the host.  The caller
+            # still gets a real verdict; the hop is journaled, counted
+            # in bls_degrade_total, and bundled.
+            return PendingVerdict(
+                value=self._native_fallback_verdict(sets, where="dispatch", error=e),
+                device="native", deadline=deadline,
+            )
 
-    def dispatch(self, packed, deadline: Optional[float] = None) -> PendingVerdict:
-        """Place one packed batch on the least-loaded device executor and
-        enqueue it — returns immediately (the jax dispatch is
-        asynchronous; compile, if cold, is not).  The executor's in-flight
-        slot is held until the verdict's first ``result()`` completes, so
-        back-to-back dispatches (chunked range-sync batches, pipelined
-        pool flushes) spread across the device pool.
+    def dispatch(self, packed, deadline: Optional[float] = None, sets=None,
+                 _attempt: int = 0,
+                 _exclude: Optional[DeviceExecutor] = None) -> PendingVerdict:
+        """Place one packed batch on the least-loaded HEALTHY device
+        executor and enqueue it — returns immediately (the jax dispatch
+        is asynchronous; compile, if cold, is not).  The executor's
+        in-flight slot is held until the verdict's first ``result()``
+        completes — success or raise — so back-to-back dispatches
+        (chunked range-sync batches, pipelined pool flushes) spread
+        across the device pool.
 
         A compile failure on the fused path (Mosaic lowering) degrades
         this verifier to the XLA-graph kernels and retries once — a bad
-        kernel must not take block import down with it."""
+        kernel must not take block import down with it.  ``sets`` (the
+        original signature sets, optional) lets a failed verdict walk
+        the rest of the ladder: requeue onto a surviving executor, then
+        the host-native tier.  ``_attempt``/``_exclude`` are the requeue
+        path's generation counter and just-failed executor."""
         live = int(np.sum(np.asarray(packed[6])))
         with self._stats_lock:
             self.dispatches += 1
@@ -616,10 +1025,16 @@ class TpuBlsVerifier:
         # may degrade self.fused mid-flight, and the except arm must judge
         # the path that actually raised, not the flag's latest value
         used_fused = self._resolve_fused()
-        ex = self._acquire_executor()
+        ex = self._acquire_executor(exclude=_exclude)
         t_disp = time.perf_counter()
         try:
             try:
+                # chaos seam: injected compile failure on the active path
+                if CHAOS.armed:
+                    CHAOS.maybe_raise(
+                        "bls.compile", where="dispatch", device=ex.name,
+                        bucket=n, fused=used_fused,
+                    )
                 # ledger attribution: a first-call compile classifies as
                 # cold/warm_load; an already-live program records an
                 # in-process hit — the three-way split the cold-start
@@ -632,11 +1047,8 @@ class TpuBlsVerifier:
             except Exception as e:  # noqa: BLE001
                 if not used_fused:
                     raise
-                logger.warning("fused dispatch failed (%s); degrading to XLA kernels", e)
-                JOURNAL.record(
-                    "bls.degrade", level="WARNING", where="dispatch",
-                    bucket=n, device=ex.name, error=str(e)[:300],
-                )
+                self._degrade(where="dispatch", tier="xla",
+                              bucket=n, device=ex.name, error=e)
                 self.fused = False
                 with self._stats_lock:
                     self.fused_fallbacks += 1
@@ -647,13 +1059,22 @@ class TpuBlsVerifier:
                     _PROGRAM_MEMO.pop(
                         self._memo_key((n, self.host_final_exp, True), ex), None
                     )
+                # chaos seam: the XLA hop can be failed independently
+                # (match {"fused": False}) to drive the batch to the
+                # native tier — the full-ladder campaign scenario
+                if CHAOS.armed:
+                    CHAOS.maybe_raise(
+                        "bls.compile", where="dispatch", device=ex.name,
+                        bucket=n, fused=False,
+                    )
                 with COMPILE_LEDGER.attribute(
                     _entry_name((n, self.host_final_exp, False)),
                     bucket=n, device=ex.name,
                 ):
                     out = self._fn(n, fused=False, executor=ex)(*packed)
-        except Exception:
+        except Exception as e:
             self._release_executor(ex)
+            self._record_executor_failure(ex, e)
             raise
         dt_disp = time.perf_counter() - t_disp
         with self._stats_lock:
@@ -685,7 +1106,7 @@ class TpuBlsVerifier:
             JOURNAL.record("bls.dispatch", cid=cid, device=ex.name, bucket=n,
                            sets=live, fused=used_fused,
                            inflight=ex.inflight, devices_total=self.n_devices,
-                           deadline_headroom_s=headroom)
+                           deadline_headroom_s=headroom, attempt=_attempt or None)
         token = INFLIGHT.register(cid=cid, device=ex.name, bucket=n, sets=live,
                                   deadline_s=headroom)
 
@@ -693,12 +1114,26 @@ class TpuBlsVerifier:
             INFLIGHT.resolve(token)
             self._release_executor(ex)
 
+        # chaos seams: an armed plan can lose this device mid-flight
+        # (result() raises) or wedge it (result() blocks out the watchdog
+        # window, then raises) — drawn HERE, deterministically, per
+        # placement; the disarmed path costs one attribute read
+        fault = None
+        if CHAOS.armed:
+            fault = (
+                CHAOS.fire("device.loss", device=ex.name, bucket=n, cid=cid)
+                or CHAOS.fire("device.wedge", device=ex.name, bucket=n, cid=cid)
+            )
         if self.host_final_exp:
             f, ok = out
             return PendingVerdict(verifier=self, f=f, ok=ok, release=release,
-                                  device=ex.name, deadline=deadline)
+                                  device=ex.name, deadline=deadline,
+                                  packed=packed, sets=sets, executor=ex,
+                                  attempt=_attempt, fault=fault)
         return PendingVerdict(verifier=self, out=out, release=release,
-                              device=ex.name, deadline=deadline)
+                              device=ex.name, deadline=deadline,
+                              packed=packed, sets=sets, executor=ex,
+                              attempt=_attempt, fault=fault)
 
     def close(self) -> None:
         for ex in self._executors:

@@ -182,6 +182,7 @@ class PyBlsVerifier:
     def __init__(self) -> None:
         self.batch_retries = 0
         self.batch_sigs_success = 0
+        self.malformed_rejects = 0
 
     def verify_signature_sets(self, sets: Sequence[SignatureSet]) -> bool:
         if not sets:
@@ -192,6 +193,9 @@ class PyBlsVerifier:
         try:
             triples = [_deserialize(s) for s in sets]
         except ValueError:
+            # malformed bytes read as an invalid-signature verdict; the
+            # counter keeps the rejection visible (bls-silent-except)
+            self.malformed_rejects += 1
             return False
         if len(triples) >= MIN_SET_COUNT_TO_BATCH:
             if verify_multiple_signatures(triples):

@@ -36,6 +36,7 @@ import sys
 import time
 from typing import Any, Dict, List, Optional
 
+from ..chaos import CHAOS
 from ..tracing import TRACER, to_chrome_trace
 from .journal import JOURNAL
 from .watchdog import INFLIGHT
@@ -65,11 +66,16 @@ def _pool_stats(pool) -> Dict[str, Any]:
 def _verifier_stats(verifier) -> Dict[str, Any]:
     out: Dict[str, Any] = {"type": type(verifier).__name__}
     for attr in ("dispatches", "sets_verified", "fused_fallbacks",
-                 "pack_rejected", "n_devices"):
+                 "pack_rejected", "n_devices", "batches_requeued",
+                 "native_fallbacks"):
         if hasattr(verifier, attr):
             out[attr] = getattr(verifier, attr)
     if hasattr(verifier, "device_inflight"):
         out["device_inflight"] = verifier.device_inflight()
+    if hasattr(verifier, "executor_health"):
+        # the self-healing pool's state machine — the chaos triage
+        # section of tools/inspect_bundle.py reads this
+        out["health"] = verifier.executor_health()
     if hasattr(verifier, "stage_seconds"):
         out["stage_seconds"] = {
             k: round(v, 4) for k, v in dict(verifier.stage_seconds).items()
@@ -140,6 +146,10 @@ def write_bundle(
 
     def section(fname: str, producer) -> None:
         try:
+            # chaos seam: an armed plan can fail any section's IO — the
+            # per-section isolation below is exactly what it exercises
+            if CHAOS.armed:
+                CHAOS.maybe_raise("forensics.io", section=fname)
             producer(os.path.join(path, fname))
             files.append(fname)
         except Exception as e:  # noqa: BLE001
@@ -176,6 +186,10 @@ def write_bundle(
         "inflight": inflight_snapshot,
         "stalled": [e for e in inflight_snapshot if e.get("stalled")],
     }
+    if CHAOS.armed or CHAOS.injected:
+        # an armed (or previously-fired) fault plan is evidence: the
+        # bundle must say which faults were induced, with which seed
+        manifest["chaos"] = CHAOS.state()
     if extra:
         manifest.update(extra)
     if errors:

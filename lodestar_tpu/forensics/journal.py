@@ -65,6 +65,14 @@ class EventJournal:
     def capacity(self) -> int:
         return self._buf.maxlen or 0
 
+    @property
+    def seq(self) -> int:
+        """Next sequence number — a watermark: every event recorded after
+        reading this carries ``seq >=`` the returned value (the chaos
+        campaign scopes its per-scenario journal scans with it)."""
+        with self._lock:
+            return self._seq
+
     def configure(self, capacity: Optional[int] = None) -> None:
         with self._lock:
             if capacity is not None and capacity != self._buf.maxlen:

@@ -254,6 +254,31 @@ class Metrics:
             "pool flush (sets/wall, NOT divided by device count) — the "
             "headline the sharded-kernel roadmap item is measured against",
         )
+        # chaos campaign & self-healing device pool (round 12, docs/chaos.md)
+        self.bls_degrade_total = r.counter(
+            "lodestar_bls_degrade_total",
+            "degradation-ladder hops (fused -> XLA -> host-native) by "
+            "failure site and the tier degraded TO — the metric face of "
+            "the bls.degrade journal events (one increment per hop)",
+            labels=("where", "tier"),
+        )
+        self.bls_batch_requeues_total = r.counter(
+            "lodestar_bls_batch_requeues_total",
+            "failed in-flight batches re-dispatched (same packed payload) "
+            "onto a surviving executor before any per-job retry",
+        )
+        self.bls_device_quarantines_total = r.counter(
+            "lodestar_bls_device_quarantines_total",
+            "executor quarantine entries (threshold consecutive failures, "
+            "or a failed re-admission probe) per device",
+            labels=("device",),
+        )
+        self.bls_device_health = r.gauge(
+            "lodestar_bls_device_health",
+            "executor health state per device: 0 healthy, 1 suspect, "
+            "2 probing (one re-admission batch in flight), 3 quarantined",
+            labels=("device",),
+        )
         # flight recorder & failure forensics (round 9)
         self.bls_watchdog_stalls_total = r.counter(
             "lodestar_bls_watchdog_stalls_total",

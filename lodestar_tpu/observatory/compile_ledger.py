@@ -254,8 +254,21 @@ class CompileLedger:
                 data = json.load(f)
             if data.get("schema") == SCHEMA_VERSION:
                 return data.get("records", {})
-        except (OSError, ValueError):
-            pass
+        except OSError:
+            pass  # no ledger yet: the normal first-run state
+        except ValueError as e:
+            # a CORRUPT ledger is survivable (start from empty records)
+            # but must be diagnosable: the chaos campaign's
+            # cache-corruption class asserts this event exists
+            try:
+                from ..forensics.journal import JOURNAL
+
+                JOURNAL.record(
+                    "cache.corrupt", level="WARNING", path=path,
+                    error=str(e)[:200],
+                )
+            except Exception:
+                pass
         return {}
 
     @staticmethod

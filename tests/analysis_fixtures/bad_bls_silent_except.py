@@ -1,0 +1,58 @@
+"""Known-bad fixture: silent ``except`` arms on the BLS dispatch path.
+
+Each marked handler swallows a failure without journaling, counting, or
+re-raising — the invisibility class the chaos plane (lodestar_tpu/chaos)
+exists to flush out: a lost device or failed compile that leaves NO
+evidence anywhere.  Parsed by tests/test_static_analysis.py (scoped as a
+``crypto/bls/`` path), never imported.
+"""
+
+
+def silent_swallows(verifier, packed, fut, logger, JOURNAL):
+    try:
+        out = verifier.dispatch(packed)
+    except Exception:  # VIOLATION: lost dispatch, zero evidence
+        out = None
+    try:
+        ok = out.result()
+    except ValueError:  # VIOLATION: swallowed into a silent False verdict
+        ok = False
+    try:
+        fut.set_result(ok)
+    except RuntimeError:  # VIOLATION: assignment-only handler hides the drop
+        ok = None
+    return ok
+
+
+def sanctioned_shapes(verifier, packed, fut, logger, JOURNAL, metrics):
+    # journaling, counting, propagating, and re-raising are all sanctioned
+    try:
+        out = verifier.dispatch(packed)
+    except Exception as e:
+        JOURNAL.record("bls.degrade", error=str(e))
+        raise
+    try:
+        ok = out.result()
+    except ValueError as e:
+        logger.warning("verdict failed: %s", e)  # WARNING+ mirrors to journal
+        ok = False
+    try:
+        fut.set_result(ok)
+    except RuntimeError as e:
+        fut.set_exception(e)  # propagation onto the future is evidence
+    try:
+        verifier.pack(packed)
+    except ValueError:
+        verifier.pack_rejected += 1  # counting is evidence
+    try:
+        verifier.close()
+    except OSError:
+        metrics.bls_degrade_total.labels(where="close", tier="native").inc()
+    return ok
+
+
+def suppressed(out):
+    try:
+        return out.result()
+    except Exception:  # lint: disable=bls-silent-except
+        return None

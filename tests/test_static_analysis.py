@@ -28,6 +28,7 @@ from lodestar_tpu.analysis import jaxpr_audit, lock_audit
 from lodestar_tpu.analysis.ast_lint import (
     AsyncBlockingSyncChecker,
     AwaitHoldingLockChecker,
+    BlsSilentExceptChecker,
     MetricsCoverageChecker,
     TracingWallclockChecker,
     lint_source,
@@ -121,6 +122,32 @@ class TestAstFixtures:
             src, "lodestar_tpu/chain/_fixture.py",
             AwaitHoldingLockChecker(), "await-holding-lock",
         )
+
+    def test_bls_silent_except_fixture(self):
+        src = fixture_source("bad_bls_silent_except.py")
+        self._assert_fires_on_marks(
+            src, "lodestar_tpu/crypto/bls/_fixture.py",
+            BlsSilentExceptChecker(), "bls-silent-except",
+        )
+
+    def test_bls_silent_except_pool_scope_and_out_of_scope(self):
+        """The rule bites chain/bls_pool.py but NOT the rest of the tree
+        (other packages have their own error-handling disciplines)."""
+        src = (
+            "def f(x):\n"
+            "    try:\n"
+            "        return x()\n"
+            "    except Exception:\n"
+            "        return None\n"
+        )
+        checker = BlsSilentExceptChecker()
+        in_pool = lint_source(src, "lodestar_tpu/chain/bls_pool.py", [checker])
+        assert [v.rule for v in in_pool] == ["bls-silent-except"]
+        assert in_pool[0].line == 4  # the except handler's line
+        out_of_scope = lint_source(
+            src, "lodestar_tpu/chain/beacon_chain.py", [checker]
+        )
+        assert out_of_scope == []
 
     def test_metrics_coverage_fixture(self, tmp_path):
         reg_dir = tmp_path / "lodestar_tpu" / "metrics"

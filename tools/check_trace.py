@@ -25,8 +25,12 @@ correlated (same ``args.cid``) for at least N distinct merged batches
 (any ``bls.dispatch`` span carries ``args.devices_total > 1``) it also
 asserts the dispatches landed on >= 2 distinct ``args.device`` ids — a
 pool that funnels every batch to one chip is a scheduler bug, not a
-pipeline.  This is the acceptance gate for a ``--trace-dump`` dev-chain
-run; tests/test_tracing.py drives it in-process.
+pipeline.  ``bls.shed`` spans (overload policy) exclude their cid from
+the pipeline requirement; ``bls.requeue`` spans (self-healing pool,
+docs/chaos.md) do NOT — a requeued cid must still complete its pipeline
+via the replay, and must show >= 2 ``bls.dispatch`` attempts.  This is
+the acceptance gate for a ``--trace-dump`` dev-chain run;
+tests/test_tracing.py drives it in-process.
 
 Exit 0 on success; exit 1 with one error per line on failure.
 """
@@ -43,6 +47,12 @@ PIPELINE_SPANS = ("bls.queue_wait", "bls.pack", "bls.dispatch", "bls.final_exp")
 #: pack/dispatch — --require-pipeline must not count it as a broken
 #: pipeline, and its presence is reported, not errored
 SHED_SPAN = "bls.shed"
+#: a failed in-flight batch re-dispatched onto a surviving executor
+#: (self-healing pool, docs/chaos.md).  A requeued cid must STILL satisfy
+#: --require-pipeline — the replay emits fresh dispatch/final_exp spans —
+#: and additionally must show >= 2 dispatch attempts (a requeue span with
+#: no re-dispatch means the recovery path lost the batch)
+REQUEUE_SPAN = "bls.requeue"
 _TS_PHASES = {"X", "B", "E", "i", "I"}
 
 
@@ -100,6 +110,8 @@ def validate_pipeline(trace: Any, min_batches: int = 2) -> List[str]:
     events = trace.get("traceEvents", trace) if isinstance(trace, dict) else trace
     by_cid: Dict[Any, Dict[str, float]] = {}
     shed_cids = set()
+    requeued_cids = set()
+    dispatches_by_cid: Dict[Any, int] = {}
     devices_seen = set()
     devices_total = 1
     for ev in events:
@@ -111,6 +123,11 @@ def validate_pipeline(trace: Any, min_batches: int = 2) -> List[str]:
             if cid is not None:
                 shed_cids.add(cid)
             continue
+        if name == REQUEUE_SPAN:
+            cid = (ev.get("args") or {}).get("cid", ev.get("id"))
+            if cid is not None:
+                requeued_cids.add(cid)
+            continue
         if name not in PIPELINE_SPANS:
             continue
         args = ev.get("args") or {}
@@ -121,6 +138,8 @@ def validate_pipeline(trace: Any, min_batches: int = 2) -> List[str]:
         cid = args.get("cid", ev.get("id"))
         if cid is None:
             continue
+        if name == "bls.dispatch":
+            dispatches_by_cid[cid] = dispatches_by_cid.get(cid, 0) + 1
         stages = by_cid.setdefault(cid, {})
         stages[name] = max(stages.get(name, 0.0), float(ev.get("dur", 0)))
     complete = [
@@ -149,6 +168,15 @@ def validate_pipeline(trace: Any, min_batches: int = 2) -> List[str]:
             f"dispatches landed on {sorted(devices_seen)} — expected >= 2 "
             f"distinct device ids"
         )
+    # a requeued batch (bls.requeue) must show its replay: >= 2 dispatch
+    # attempts under the same cid, else the recovery path lost the batch
+    for cid in sorted(requeued_cids, key=str):
+        if dispatches_by_cid.get(cid, 0) < 2:
+            errors.append(
+                f"pipeline: cid {cid} carries a {REQUEUE_SPAN} span but only "
+                f"{dispatches_by_cid.get(cid, 0)} bls.dispatch attempt(s) — "
+                f"a requeue must re-dispatch on a surviving executor"
+            )
     return errors
 
 

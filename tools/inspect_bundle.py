@@ -26,7 +26,10 @@ Summary (the triage view — what a responder needs FIRST after a death):
 - the last JAX compile/cache event (was a compile in flight?);
 - stalled batches: cid, device, bucket, age at flag time;
 - per-device in-flight counts at dump time;
-- the last ERROR/WARNING journal events (the stderr that got lost).
+- the last ERROR/WARNING journal events (the stderr that got lost);
+- chaos triage (docs/chaos.md): the armed fault plan's seed, the last
+  injected fault (seam + context), requeued-batch count, per-executor
+  health states, and the quarantine/re-admission timeline.
 
 ``--json`` prints the summary as one JSON object instead of text
 (bench tooling and tests consume this form).
@@ -175,12 +178,38 @@ def summarize(bundle_dir: str) -> Dict[str, Any]:
                 inflight_file = json.load(f)
         except ValueError:
             pass
+    # chaos triage (docs/chaos.md): what was INDUCED (manifest.chaos from
+    # the armed fault plan), what the self-healing pool did about it
+    # (bls.requeue / bls.health journal events), and where every executor's
+    # health state machine stands (inflight.json verifier.health)
+    chaos_manifest = manifest.get("chaos") or {}
+    injected = chaos_manifest.get("injected") or []
+    requeues = [e for e in events if e.get("kind") == "bls.requeue"]
+    health_events = [e for e in events if e.get("kind") == "bls.health"]
+    health_timeline = [
+        {k: e.get(k) for k in ("wall", "device", "state", "failures",
+                               "backoff_s", "readmitted")}
+        for e in health_events
+    ]
+    verifier_stats = (inflight_file or {}).get("verifier") or {}
+    chaos_summary: Optional[Dict[str, Any]] = None
+    if injected or requeues or health_events or chaos_manifest:
+        chaos_summary = {
+            "armed": chaos_manifest.get("armed"),
+            "seed": chaos_manifest.get("seed"),
+            "last_fault": injected[-1] if injected else None,
+            "injected_total": len(injected),
+            "requeued_batches": len(requeues),
+            "executor_health": verifier_stats.get("health"),
+            "health_timeline": health_timeline,
+        }
     return {
         "bundle": bundle_dir,
         "reason": manifest.get("reason"),
         "created_unix": manifest.get("created_unix"),
         "pid": manifest.get("pid"),
         "schema": manifest.get("schema"),
+        "chaos": chaos_summary,
         "dump_errors": manifest.get("errors"),
         "journal_events": manifest.get("journal", {}).get("events"),
         "journal_dropped": manifest.get("journal", {}).get("dropped"),
@@ -232,6 +261,26 @@ def _print_text(s: Dict[str, Any]) -> None:
         if ov.get("dropped_by_reason"):
             for reason, n in sorted(ov["dropped_by_reason"].items()):
                 print(f"  shed reason {reason:13s} {n} sets")
+    ch = s.get("chaos")
+    if ch:
+        lf = ch.get("last_fault") or {}
+        print(f"CHAOS: plan {'armed' if ch.get('armed') else 'disarmed'} "
+              f"(seed {ch.get('seed')}), {ch.get('injected_total')} fault(s) "
+              f"injected, {ch.get('requeued_batches')} batch(es) requeued")
+        if lf:
+            print(f"  last fault  seam={lf.get('seam')} seed={lf.get('seed')} "
+                  f"ctx={lf.get('ctx')}")
+        for dev, h in sorted((ch.get("executor_health") or {}).items()):
+            extra_h = ""
+            if h.get("readmission_in_s") is not None:
+                extra_h = f" readmission in {h['readmission_in_s']}s"
+            print(f"  health {dev:12s} {h.get('state'):11s} "
+                  f"failures={h.get('failures')} "
+                  f"quarantines={h.get('quarantines')}{extra_h}")
+        for e in ch.get("health_timeline") or []:
+            tag = " (re-admitted)" if e.get("readmitted") else ""
+            print(f"  {e.get('wall')}  {e.get('device')} -> {e.get('state')}"
+                  f"{tag} failures={e.get('failures')}")
     if s["stalled"]:
         print("STALLED batches:")
         for e in s["stalled"]:
