@@ -1,0 +1,16 @@
+"""Durable AOT executable store (ROADMAP item 4): fully-compiled XLA
+executables persisted across processes so a restart loads in seconds
+instead of re-paying trace + lower + compile.  See ``store.py`` for the
+key schema and crash-consistency discipline, ``tools/prewarm.py`` for
+the out-of-band population farm, and ``docs/aot.md`` for the runbook."""
+
+from .store import (  # noqa: F401
+    AOT_STORE,
+    AotExecutableStore,
+    AotStoreMiss,
+    STORE_ENV,
+    configure_aot_store,
+    entry_key,
+    ops_content_hash,
+    topology_tag,
+)

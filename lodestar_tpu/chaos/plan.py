@@ -41,8 +41,14 @@ failure would occur; docs/chaos.md carries the full taxonomy):
                           (the watchdog window) and THEN raises — models a
                           hung device tunnel; drives watchdog + requeue
 ``cache.corrupt``         no hook: ``corrupt_file`` deterministically
-                          flips bytes in a persistent-cache / ledger file
-                          (the campaign applies it between processes)
+                          flips bytes in a persistent-cache / ledger /
+                          AOT-store file (the campaign applies it between
+                          processes)
+``aot.midwrite``          ``maybe_kill`` inside ``aot/store.save`` between
+                          the temp-file write and the rename — models a
+                          prewarmer dying mid-write; the loader must
+                          ignore the orphan and the manifest stays
+                          consistent (manifest-written-last)
 ``bench.kill``            ``maybe_kill`` SIGKILLs the calling process —
                           models the rc=124 stage-child death; drives
                           salvage-heartbeat bundle recovery
@@ -75,6 +81,7 @@ KNOWN_SEAMS = (
     "device.loss",
     "device.wedge",
     "cache.corrupt",
+    "aot.midwrite",
     "bench.kill",
     "forensics.io",
 )

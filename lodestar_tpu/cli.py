@@ -118,6 +118,20 @@ def build_parser() -> argparse.ArgumentParser:
             "(default: $LODESTAR_TPU_JAX_CACHE or repo-local .jax_cache)",
         )
         p.add_argument(
+            "--bls-aot-store", default=None, metavar="DIR",
+            help="durable AOT executable store: fully-compiled XLA "
+            "executables persisted across restarts (populate with "
+            "tools/prewarm.py; default: $LODESTAR_TPU_AOT_STORE, else "
+            "the tier is off; docs/aot.md)",
+        )
+        p.add_argument(
+            "--bls-warmup-load-only", action="store_true",
+            help="production rolling-restart mode: warmup NEVER traces "
+            "or compiles — programs come from the AOT store or the "
+            "verifier walks the fused→XLA→native degradation ladder "
+            "(forces a blocking warmup; docs/aot.md runbook)",
+        )
+        p.add_argument(
             "--bls-devices", type=int, default=1,
             help="device executors in the BLS pool: 1 = single device "
             "(default), N = the first N local devices, 0 = every local "
@@ -448,6 +462,16 @@ def _make_verifier(args):
         from .crypto.bls.tpu_verifier import TpuBlsVerifier, configure_persistent_cache
 
         configure_persistent_cache(getattr(args, "bls_cache_dir", None))
+        from .aot import configure_aot_store
+
+        aot_store = configure_aot_store(getattr(args, "bls_aot_store", None))
+        load_only = bool(getattr(args, "bls_warmup_load_only", False))
+        if load_only and not aot_store.enabled:
+            logger.warning(
+                "--bls-warmup-load-only without an AOT store "
+                "(--bls-aot-store / $LODESTAR_TPU_AOT_STORE): every "
+                "program will miss and the verifier degrades to native"
+            )
         buckets = tuple(
             int(b) for b in str(getattr(args, "bls_buckets", "4,16,64,128,256")).split(",") if b
         )
@@ -469,10 +493,31 @@ def _make_verifier(args):
             point_cache_size=getattr(args, "bls_point_cache_size", 8192),
             quarantine_threshold=getattr(args, "bls_quarantine_threshold", 2),
             quarantine_backoff_s=getattr(args, "bls_quarantine_backoff_s", 1.0),
+            load_only=load_only,
         )
         warm = getattr(args, "bls_warmup", "background")
         profile_dir = getattr(args, "jax_profile", None)
-        if profile_dir and warm != "off":
+        if load_only and warm != "off":
+            # load-only warmup is seconds (deserialize, no compile) and
+            # its degradation verdict decides the serving tier — block.
+            # --jax-profile still brackets it: the deserialize path is
+            # exactly what a restart profile should show
+            if profile_dir:
+                import jax
+
+                jax.profiler.start_trace(profile_dir)
+                try:
+                    dt = v.warmup(load_only=True)
+                finally:
+                    jax.profiler.stop_trace()
+            else:
+                dt = v.warmup(load_only=True)
+            logger.info(
+                "bls AOT load-only warmup: %d buckets in %.1fs "
+                "(fused=%s, native_only=%s)", len(buckets), dt, v.fused,
+                v._native_tier_only,
+            )
+        elif profile_dir and warm != "off":
             # device-level profile of the AOT compiles + first dispatches;
             # forces blocking warmup so stop_trace() brackets real work
             import jax

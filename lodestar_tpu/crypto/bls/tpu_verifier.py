@@ -44,6 +44,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ...aot.store import AOT_STORE, STORE_ENV, AotStoreMiss
 from ...chaos import CHAOS, DeviceLostError
 from ...forensics.journal import JOURNAL, install_jax_monitoring
 from ...forensics.watchdog import INFLIGHT
@@ -386,6 +387,8 @@ class TpuBlsVerifier:
         quarantine_backoff_s: float = 1.0,
         quarantine_backoff_max_s: float = 60.0,
         native_verifier=None,
+        aot_store=None,
+        load_only: bool = False,
     ):
         self.buckets = tuple(sorted(buckets))
         self.platform = platform
@@ -405,6 +408,18 @@ class TpuBlsVerifier:
         # verifier (FastBlsVerifier self-falls-back to the Python oracle);
         # lazy so a healthy node never constructs it
         self._native = native_verifier
+        # durable AOT executable store (docs/aot.md): the materialization
+        # tier between the in-process memo and the persistent .jax_cache.
+        # None = the process-wide singleton (enabled when configured or
+        # when LODESTAR_TPU_AOT_STORE is set); tests inject instances.
+        self.aot_store = aot_store
+        # production restart mode: NEVER trace/compile — serve from the
+        # memo/AOT tiers and walk the degradation ladder for anything
+        # missing (the rolling-restart contract, docs/aot.md)
+        self.load_only = load_only
+        # set when a load-only warmup bottomed out: every program tier is
+        # unavailable and verdicts are served by the host-native rung
+        self._native_tier_only = False
         # one executor per device; a single default executor otherwise
         # (its device is resolved lazily at first jit so constructing a
         # verifier still never touches a JAX backend)
@@ -509,8 +524,51 @@ class TpuBlsVerifier:
         dev = (d.platform, d.id) if d is not None else ("platform", self.platform)
         return (key, dev)
 
+    # -- durable AOT executable store (the tier below the memo) --------------
+
+    def _get_aot_store(self):
+        """The active store, or None when the tier is disabled.  The
+        process-wide singleton lazily picks up LODESTAR_TPU_AOT_STORE so
+        conftest/bench can enable the tier by env alone."""
+        store = self.aot_store if self.aot_store is not None else AOT_STORE
+        if not store.enabled and store is AOT_STORE and os.environ.get(STORE_ENV):
+            store.configure()
+        return store if store.enabled else None
+
+    def _aot_load(self, key, bucket: int, ex: DeviceExecutor):
+        """One store lookup for (key, executor): a hit is ledgered as the
+        ``aot_load`` kind (flagging the enclosing attribution window when
+        dispatch owns one, recording directly from warmup otherwise).
+        Misses/corruption/skew are the store's problem — every failure
+        journals there and returns None here."""
+        store = self._get_aot_store()
+        if store is None:
+            return None
+        entry = _entry_name(key)
+        t0 = time.perf_counter()
+        fn = store.load(entry, bucket, ex.name)
+        if fn is not None:
+            COMPILE_LEDGER.note_aot_load(
+                time.perf_counter() - t0, entry=entry, bucket=bucket,
+                device=ex.name,
+            )
+        return fn
+
+    def _aot_save(self, key, bucket: int, ex: DeviceExecutor, compiled) -> None:
+        """Best-effort persist of a freshly-compiled executable (the
+        store journals its own failures; a save must never cost more than
+        the compile it rides behind)."""
+        store = self._get_aot_store()
+        if store is not None:
+            store.save(_entry_name(key), bucket, ex.name, compiled)
+
     def _fn(self, n: int, fused: Optional[bool] = None,
             executor: Optional[DeviceExecutor] = None):
+        """Materialization ladder for one program:
+        in-process memo -> durable AOT store -> persistent .jax_cache
+        (trace + lower + warm backend load) -> cold compile.  A
+        ``load_only`` verifier stops after the store tier and raises
+        ``AotStoreMiss`` — dispatch's degradation ladder owns it."""
         key = (n, self.host_final_exp, self._resolve_fused() if fused is None else fused)
         ex = executor if executor is not None else self._executors[0]
         if key not in ex.compiled:
@@ -518,9 +576,24 @@ class TpuBlsVerifier:
             with _PROGRAM_MEMO_LOCK:
                 fn = _PROGRAM_MEMO.get(mk)
             if fn is None:
-                fn = self._jit(key, ex)
-                with _PROGRAM_MEMO_LOCK:
-                    fn = _PROGRAM_MEMO.setdefault(mk, fn)
+                fn = self._aot_load(key, n, ex)
+            if fn is None:
+                if self.load_only:
+                    raise AotStoreMiss(
+                        f"load-only verifier: no stored executable for "
+                        f"{_entry_name(key)} bucket {n} on {ex.name}"
+                    )
+                store = self._get_aot_store()
+                if store is not None:
+                    # store enabled: compile AOT (same cost — the call
+                    # would compile anyway) so the executable is a real
+                    # Compiled we can serialize for the next process
+                    fn = self._jit(key, ex).lower(*self._abstract_args(n)).compile()
+                    self._aot_save(key, n, ex, fn)
+                else:
+                    fn = self._jit(key, ex)
+            with _PROGRAM_MEMO_LOCK:
+                fn = _PROGRAM_MEMO.setdefault(mk, fn)
             ex.compiled[key] = fn
         return ex.compiled[key]
 
@@ -817,18 +890,15 @@ class TpuBlsVerifier:
             S((n,), jnp.bool_),
         )
 
-    def warmup(self, buckets: Optional[Sequence[int]] = None) -> float:
-        """AOT-compile the dispatch program for every bucket of the active
-        path (``jit(...).lower(...).compile()``) on EVERY device executor,
-        populating both the in-process executable caches and the persistent
-        compilation cache.
-
-        Returns the wall seconds spent.  A bucket whose compile FAILS
-        (e.g. a Mosaic lowering bug in the fused path) degrades that
-        verifier to the XLA-graph kernels instead of raising — the node
-        must come up either way."""
-        t0 = time.perf_counter()
-        for b in tuple(buckets if buckets is not None else self.buckets):
+    def _warmup_tier(self, bucket_list, load_only: bool):
+        """One pass of the current tier (fused or XLA) over every
+        (bucket, executor): memo -> AOT store -> (unless ``load_only``)
+        persistent-cache/compile + store save.  Returns the (bucket,
+        device) pairs the store could not serve in load-only mode.  A
+        compile failure on the fused path degrades to XLA and re-runs
+        (the pre-AOT behavior, one level down)."""
+        missing = []
+        for b in bucket_list:
             key = (b, self.host_final_exp, self._resolve_fused())
             for ex in self._executors:
                 if key in ex.compiled and not hasattr(ex.compiled[key], "lower"):
@@ -840,6 +910,22 @@ class TpuBlsVerifier:
                     # another verifier instance already AOT-compiled this
                     # exact program for this device in this process
                     ex.compiled[key] = memo_fn
+                    continue
+                # durable store tier: a fully-compiled executable loads
+                # in seconds — no trace, no lower, no backend compile
+                fn = self._aot_load(key, b, ex)
+                if fn is not None:
+                    ex.compiled[key] = fn
+                    with _PROGRAM_MEMO_LOCK:
+                        _PROGRAM_MEMO[mk] = fn
+                    continue
+                if load_only:
+                    # per-entry outcome evidence: a load-only warmup miss
+                    # is the event the rolling-restart runbook triages on
+                    JOURNAL.record("aot.miss", level="WARNING",
+                                   entry=_entry_name(key), bucket=b,
+                                   device=ex.name, load_only=True)
+                    missing.append((b, ex.name))
                     continue
                 try:
                     # chaos seam: an injected compile failure surfaces
@@ -860,6 +946,9 @@ class TpuBlsVerifier:
                         ).compile()
                     with _PROGRAM_MEMO_LOCK:
                         _PROGRAM_MEMO[mk] = ex.compiled[key]
+                    # persist for the NEXT process (best-effort; the
+                    # store journals its own failures)
+                    self._aot_save(key, b, ex, ex.compiled[key])
                 except Exception as e:  # noqa: BLE001
                     logger.warning(
                         "warmup compile failed for bucket %d on %s: %s",
@@ -875,7 +964,52 @@ class TpuBlsVerifier:
                             e2.compiled.pop(key, None)
                             with _PROGRAM_MEMO_LOCK:
                                 _PROGRAM_MEMO.pop(self._memo_key(key, e2), None)
-                        return self.warmup(buckets) + (time.perf_counter() - t0)
+                        return self._warmup_tier(bucket_list, load_only)
+        return missing
+
+    def warmup(self, buckets: Optional[Sequence[int]] = None,
+               load_only: Optional[bool] = None) -> float:
+        """Materialize the dispatch program for every bucket of the
+        active path on EVERY device executor, walking the ladder
+        memo -> durable AOT store -> persistent cache -> compile — each
+        hop ledgered (``aot_load`` / ``warm_load`` / ``cold``).  Freshly
+        compiled executables are persisted back into the store.
+
+        Returns the wall seconds spent.  A bucket whose compile FAILS
+        (e.g. a Mosaic lowering bug in the fused path) degrades that
+        verifier to the XLA-graph kernels instead of raising — the node
+        must come up either way.
+
+        ``load_only`` (default: the verifier's ``load_only`` mode) is
+        the production rolling-restart contract: REFUSE to trace or
+        compile.  A program the store cannot serve walks the degradation
+        ladder instead — fused -> XLA (retry the store at the XLA tier)
+        -> host-native, exactly one ``bls.degrade`` journal event and
+        ``bls_degrade_total`` increment per hop; with nothing loadable
+        at all the verifier serves every verdict from the native rung."""
+        if load_only is None:
+            load_only = self.load_only
+        t0 = time.perf_counter()
+        bucket_list = tuple(buckets if buckets is not None else self.buckets)
+        missing = self._warmup_tier(bucket_list, load_only)
+        if load_only and missing:
+            if self._resolve_fused():
+                self._degrade(
+                    where="warmup", tier="xla",
+                    error=f"aot store missing {len(missing)} fused "
+                          f"program(s) in load-only warmup",
+                )
+                self.fused = False
+                with self._stats_lock:
+                    self.fused_fallbacks += 1
+                missing = self._warmup_tier(bucket_list, load_only)
+            if missing:
+                self._degrade(
+                    where="warmup", tier="native",
+                    error=f"aot store missing {len(missing)} XLA "
+                          f"program(s) in load-only warmup",
+                )
+                self._native_tier_only = True
         dt = time.perf_counter() - t0
         with self._stats_lock:
             self.stage_seconds["warmup"] += dt
@@ -883,7 +1017,9 @@ class TpuBlsVerifier:
             TRACER.instant("bls.warmup_done", cat="bls", seconds=round(dt, 3),
                            devices=self.n_devices)
         JOURNAL.record("bls.warmup", seconds=round(dt, 3),
-                       devices=self.n_devices, fused=self.fused)
+                       devices=self.n_devices, fused=self.fused,
+                       load_only=load_only or None,
+                       native_tier_only=self._native_tier_only or None)
         return dt
 
     def warmup_async(self, buckets: Optional[Sequence[int]] = None) -> threading.Thread:
@@ -973,6 +1109,17 @@ class TpuBlsVerifier:
         upstream."""
         if not sets:
             raise ValueError("verify_signature_sets_async: empty batch of signature sets")
+        if self._native_tier_only:
+            # load-only warmup bottomed out: the incident was journaled
+            # ONCE at warmup (bls.degrade -> native); per-batch verdicts
+            # ride the host rung quietly — no pack, no device, no repeat
+            # degrade spam
+            with self._stats_lock:
+                self.native_fallbacks += 1
+            return PendingVerdict(
+                value=self._native_verifier().verify_signature_sets(list(sets)),
+                device="native", deadline=deadline,
+            )
         largest = self.buckets[-1]
         if len(sets) > largest:
             # split oversized batches (chunkify analog, multithread/utils.ts:4)
@@ -1074,7 +1221,11 @@ class TpuBlsVerifier:
                     out = self._fn(n, fused=False, executor=ex)(*packed)
         except Exception as e:
             self._release_executor(ex)
-            self._record_executor_failure(ex, e)
+            # a load-only store miss is a POLICY refusal, not device
+            # sickness: the typed exception exists precisely so this
+            # path doesn't quarantine a healthy chip over store content
+            if not isinstance(e, AotStoreMiss):
+                self._record_executor_failure(ex, e)
             raise
         dt_disp = time.perf_counter() - t_disp
         with self._stats_lock:

@@ -229,8 +229,10 @@ class Metrics:
             "lodestar_bls_compile_seconds",
             "program materialization cost by entry and kind: cold = real "
             "XLA/Mosaic backend compile, warm_load = persistent-cache "
-            "load, hit = already live in-process (compile ledger, "
-            "persisted in .jax_cache/compile_ledger.json)",
+            "load, aot_load = durable AOT executable store deserialize "
+            "(docs/aot.md — no trace, no lower, no backend compile), "
+            "hit = already live in-process (compile ledger, persisted "
+            "in .jax_cache/compile_ledger.json)",
             buckets=COMPILE_BUCKETS_S,
             labels=("entry", "kind"),
         )

@@ -19,8 +19,11 @@ warm".  The ledger is that answer, kept across processes:
   emits ``/jax/compilation_cache/cache_hits`` and the retrieval
   duration; note the backend_compile event can still fire for the
   deserialize, which is exactly why duration alone cannot classify),
-  and ``hit`` (the program was already live in this process — no jax
-  event fires inside the attribution window at all).
+  ``aot_load`` (the durable AOT executable store served a
+  fully-compiled executable — no jax compile event fires, the verifier
+  marks the window via :meth:`CompileLedger.note_aot_load`), and
+  ``hit`` (the program was already live in this process — no jax event
+  and no AOT-load marker inside the attribution window at all).
 - **Persistence**: aggregated per-key stats in
   ``<jax-cache-dir>/compile_ledger.json`` next to the executables they
   describe, read-modify-written atomically (the jaxpr-audit artifact
@@ -49,7 +52,7 @@ CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
 CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
 CACHE_RETRIEVAL_EVENT = "/jax/compilation_cache/cache_retrieval_time_sec"
 
-KINDS = ("cold", "warm_load", "hit")
+KINDS = ("cold", "warm_load", "aot_load", "hit")
 
 #: unattributed backend compiles below this duration are ignored — ad-hoc
 #: test/tooling jits fire the event for every tiny throwaway program, and
@@ -77,8 +80,10 @@ class _Attribution(threading.local):
         self.device = None
         self.compile_s = 0.0
         self.retrieval_s = 0.0
+        self.aot_load_s = 0.0
         self.saw_cache_hit = False
         self.saw_cache_miss = False
+        self.saw_aot_load = False
 
 
 class CompileLedger:
@@ -153,13 +158,17 @@ class CompileLedger:
             return
         ctx.active = True
         ctx.entry, ctx.bucket, ctx.device = entry, bucket, device
-        ctx.compile_s = ctx.retrieval_s = 0.0
-        ctx.saw_cache_hit = ctx.saw_cache_miss = False
+        ctx.compile_s = ctx.retrieval_s = ctx.aot_load_s = 0.0
+        ctx.saw_cache_hit = ctx.saw_cache_miss = ctx.saw_aot_load = False
         try:
             yield
         finally:
             ctx.active = False
-            if ctx.saw_cache_hit:
+            if ctx.saw_aot_load:
+                # the AOT executable store served the program: no jax
+                # compile event fires, the verifier marked the window
+                kind, seconds = "aot_load", ctx.aot_load_s
+            elif ctx.saw_cache_hit:
                 kind, seconds = "warm_load", ctx.compile_s or ctx.retrieval_s
             elif ctx.compile_s > 0 or ctx.saw_cache_miss:
                 kind, seconds = "cold", ctx.compile_s
@@ -167,8 +176,23 @@ class CompileLedger:
                 kind, seconds = "hit", 0.0
             # consume the flags on exit: a warm_load's hit marker must not
             # leak into the NEXT (unattributed) compile on this thread
-            ctx.saw_cache_hit = ctx.saw_cache_miss = False
+            ctx.saw_cache_hit = ctx.saw_cache_miss = ctx.saw_aot_load = False
             self.record(entry, bucket, device, kind, seconds)
+
+    def note_aot_load(self, seconds: float, entry: Optional[str] = None,
+                      bucket: Optional[int] = None,
+                      device: Optional[str] = None) -> None:
+        """Mark the current attribution window as served by the AOT
+        executable store (classified ``aot_load`` on exit).  Outside any
+        window the load is recorded directly under the given key."""
+        if not self.enabled:
+            return
+        ctx = self._ctx
+        if ctx.active:
+            ctx.saw_aot_load = True
+            ctx.aot_load_s += seconds
+        else:
+            self.record(entry or "other", bucket, device, "aot_load", seconds)
 
     def on_jax_event(self, event: str, duration: Optional[float] = None) -> None:
         """Sink for the journal's jax.monitoring listeners (plain events

@@ -39,6 +39,7 @@ TRIPWIRES: Dict[str, Tuple[int, float]] = {
     "bls_sig_sets_per_s": (+1, 0.10),
     "scaling_efficiency": (+1, 0.10),
     "cold_start_warm_s": (-1, 0.25),
+    "cold_start_aot_s": (-1, 0.25),
     "cold_start_cold_s": (-1, 0.25),
     "dev_chain_blocks_per_s": (+1, 0.15),
     "range_sync_blocks_per_s": (+1, 0.15),
@@ -109,6 +110,7 @@ def extract_metrics(run: dict) -> Dict[str, Optional[float]]:
         or mc.get("sets_per_sec_total"),
         "scaling_efficiency": mc.get("scaling_efficiency"),
         "cold_start_warm_s": cs.get("warm_s"),
+        "cold_start_aot_s": cs.get("aot_s"),
         "cold_start_cold_s": cs.get("cold_s"),
         "dev_chain_blocks_per_s": ex.get("dev_chain_blocks_per_s"),
         "range_sync_blocks_per_s": ex.get("range_sync_blocks_per_s"),

@@ -29,6 +29,12 @@ Scenarios (all driven from ONE seed; repro = rerun with the same seed):
                     fused->XLA->native ladder, one degrade event per hop
     cache_corrupt   persistent compile-ledger file corrupted on disk ->
                     survivable + journaled (cache.corrupt)
+    aot_corrupt     durable AOT executable store faults (docs/aot.md):
+                    corrupt entry -> aot.corrupt + quarantine; truncated
+                    manifest -> survivable; jax-version skew -> aot.skew
+                    + eviction; prewarmer SIGKILLed mid-write -> orphan
+                    temp ignored, manifest consistent, stale lock broken;
+                    the live pool then still verifies (recompile)
     bench_kill      spawn child SIGKILLed mid-stage -> salvage heartbeat
                     bundle recovered pid-scoped by the parent
     forensics_io    bundle section writer raises -> per-section isolation
@@ -564,6 +570,186 @@ def scenario_cache_corrupt(seed: int, out_dir: str, inspect_bundle,
     return res
 
 
+def _tiny_compiled():
+    """A real (tiny, ms-to-compile) CPU executable under the bls key
+    schema — what the aot_corrupt scenario seeds its store with."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda *a: jnp.asarray(True))
+    args = [jax.ShapeDtypeStruct((4,), jnp.float32)]
+    return fn.lower(*args).compile()
+
+
+class _TinyKernelVerifier:
+    """Factory for a real TpuBlsVerifier whose kernels are tiny jits (a
+    compile costs ms, not minutes) — the aot_corrupt scenario drives the
+    REAL materialization ladder (store load -> corrupt -> recompile ->
+    store save) through it with a live pool on top."""
+
+    @staticmethod
+    def build(aot_store):
+        import jax.numpy as jnp
+
+        from lodestar_tpu.crypto.bls.tpu_verifier import TpuBlsVerifier
+
+        v = TpuBlsVerifier(buckets=(4,), fused=False, host_final_exp=False,
+                           platform="cpu", aot_store=aot_store,
+                           native_verifier=_StubNative())
+        v._kernel = lambda key: (lambda *a: jnp.asarray(True))
+        return v
+
+
+def _aot_midwrite_child(plan_json: str, store_dir: str) -> None:
+    """Spawn-child entry for the prewarmer-killed-mid-write class: arm
+    the plan, then save an entry — the ``aot.midwrite`` seam SIGKILLs
+    between the temp-file write and the rename, leaving an orphan temp,
+    an un-updated manifest, and a stale writer lock behind."""
+    sys.path.insert(0, _REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from lodestar_tpu.aot.store import AotExecutableStore
+    from lodestar_tpu.chaos import CHAOS as child_chaos
+    from lodestar_tpu.chaos import FaultPlan as ChildPlan
+
+    child_chaos.install(ChildPlan.from_json(plan_json))
+    store = AotExecutableStore(path=store_dir)
+    store.save("xla_full", 4, "midwrite-victim", _tiny_compiled())
+    os._exit(7)  # plan did not fire: the parent treats this as a failure
+
+
+def scenario_aot_corrupt(seed: int, out_dir: str, inspect_bundle,
+                         check_trace, fast: bool) -> Dict[str, Any]:
+    res: Dict[str, Any] = {"name": "aot_corrupt", "verdicts_lost": 0}
+    failures: List[str] = []
+    from lodestar_tpu.aot.store import AotExecutableStore
+
+    seq0 = JOURNAL.seq
+    store_dir = os.path.join(out_dir, "aot_store")
+    store = AotExecutableStore(path=store_dir)
+    compiled = _tiny_compiled()
+
+    # -- corrupt entry: checksum rejection + quarantine ----------------------
+    key = store.save("xla_full", 4, "default", compiled)
+    if key is None:
+        failures.append("store save failed (scenario setup)")
+    else:
+        fpath = os.path.join(store_dir, store.keys()[key]["file"])
+        res["flipped_offsets"] = corrupt_file(fpath, seed=seed)[:8]
+        fresh = AotExecutableStore(path=store_dir)
+        if fresh.load("xla_full", 4, "default") is not None:
+            failures.append("corrupt store entry still loaded")
+        if fresh.corrupt != 1:
+            failures.append("corrupt entry not counted as corrupt")
+        if not os.path.exists(fpath + ".quarantined"):
+            failures.append("corrupt entry was not quarantined aside")
+
+    # -- jax-version skew: eviction ------------------------------------------
+    key2 = store.save("fused_full", 4, "default", compiled)
+    if key2 is not None:
+        mpath = os.path.join(store_dir, "manifest.json")
+        doc = json.load(open(mpath))
+        doc["entries"][key2]["jax"] = "0.0.0-skewed"
+        json.dump(doc, open(mpath, "w"))
+        skewed = AotExecutableStore(path=store_dir)
+        if skewed.load("fused_full", 4, "default") is not None:
+            failures.append("version-skewed entry still loaded")
+        if skewed.skew != 1:
+            failures.append("skewed entry not counted as skew")
+        if key2 in skewed.keys():
+            failures.append("skewed entry not evicted from the manifest")
+
+    # -- truncated manifest: survivable + journaled --------------------------
+    mpath = os.path.join(store_dir, "manifest.json")
+    blob = open(mpath, "rb").read()
+    open(mpath, "wb").write(blob[: max(1, len(blob) // 2)])
+    truncated = AotExecutableStore(path=store_dir)
+    if truncated.keys() != {}:
+        failures.append("truncated manifest produced entries")
+
+    # -- prewarmer killed mid-write (its own pristine store, so the
+    # orphan/lock assertions are not confounded by the faults above) ---------
+    kill_dir = os.path.join(out_dir, "aot_store_midwrite")
+    plan = FaultPlan(seed).add("aot.midwrite", count=1)
+    ctx = multiprocessing.get_context("spawn")
+    p = ctx.Process(target=_aot_midwrite_child,
+                    args=(plan.to_json(), kill_dir), daemon=True)
+    p.start()
+    p.join(60)
+    if p.is_alive():
+        p.kill()
+        p.join(10)
+        failures.append("midwrite child never died (plan did not fire)")
+    elif p.exitcode != -9:
+        failures.append(f"midwrite child exitcode {p.exitcode}, expected -9")
+    entries_dir = os.path.join(kill_dir, "entries")
+    orphans = [
+        n for n in (os.listdir(entries_dir) if os.path.isdir(entries_dir) else [])
+        if ".tmp" in n
+    ]
+    res["orphan_temp_files"] = len(orphans)
+    if not orphans:
+        failures.append("no orphan temp file from the killed writer")
+    after_kill = AotExecutableStore(path=kill_dir)
+    if after_kill.load("xla_full", 4, "midwrite-victim") is not None:
+        failures.append("half-written entry was loadable")
+    if after_kill.corrupt:
+        failures.append("orphan temp misclassified as corruption (must be a plain miss)")
+    # the dead child's writer lock must not wedge the next writer
+    if after_kill.save("xla_split", 4, "default", compiled) is None:
+        failures.append("stale writer lock from the killed child wedged the next save")
+
+    # -- the node still verifies: live pool over the damaged store -----------
+    from lodestar_tpu.chain.bls_pool import BlsBatchPool
+
+    v = _TinyKernelVerifier.build(AotExecutableStore(path=store_dir))
+    pool = BlsBatchPool(v, max_buffer_wait=0.002, flush_threshold=8,
+                        pipeline_depth=2)
+    RECORDER.configure(forensics_dir=out_dir, pool=pool, verifier=v)
+    try:
+        recovered = asyncio.run(run_jobs(pool, 4 if fast else 8))
+    finally:
+        pool.close()
+        # the tiny always-True programs live in the PROCESS-global memo
+        # under real bucket-4 keys — evict them or a later scenario's
+        # real bucket-4 dispatch would inherit a forged-verdict stub
+        from lodestar_tpu.crypto.bls.tpu_verifier import _PROGRAM_MEMO
+
+        for ex in v._executors:
+            for key in list(ex.compiled):
+                _PROGRAM_MEMO.pop(v._memo_key(key, ex), None)
+            ex.compiled.clear()
+    res["verdicts_lost"] = recovered["verdicts_lost"]
+    if recovered["verdicts_lost"]:
+        failures.append(f"{recovered['verdicts_lost']} stranded futures")
+    if recovered["outcomes"]["false"] or recovered["errors"]:
+        failures.append(
+            f"post-fault verdicts wrong: {recovered['outcomes']}, "
+            f"{recovered['errors'][:2]}"
+        )
+
+    # -- evidence: journal events + a triagable bundle (the midwrite
+    # kill's chaos.inject lives in the CHILD's journal and dies with it —
+    # its evidence is the -9 exitcode + the orphan temp asserted above) ------
+    events = _journal_since(seq0)
+    for kind in ("aot.corrupt", "aot.skew"):
+        if _first(events, lambda e, k=kind: e.get("kind") == k) is None:
+            failures.append(f"no {kind} journal event — fault invisible")
+    bundle = RECORDER.dump("aot-corrupt", metric_reason="chaos")
+    summary = _validated_bundle(inspect_bundle, bundle, res)
+    if summary is not None:
+        aot = summary.get("aot") or {}
+        if not aot.get("last_corrupt"):
+            failures.append("bundle aot triage missing the corrupt event")
+        if not aot.get("last_skew"):
+            failures.append("bundle aot triage missing the skew event")
+        if not aot.get("store"):
+            failures.append("bundle aot triage missing the store path")
+    if failures:
+        res.setdefault("failures", []).extend(failures)
+    res["ok"] = not res.get("failures")
+    return res
+
+
 def _kill_child(plan_json: str, stage: str, base_dir: str) -> None:
     """Spawn-child entry for the bench-kill scenario: heartbeat once,
     then die the way a wedged bench stage does (SIGKILL from outside has
@@ -660,6 +846,7 @@ SCENARIOS = (
     scenario_device_wedge,
     scenario_compile_ladder,
     scenario_cache_corrupt,
+    scenario_aot_corrupt,
     scenario_bench_kill,
     scenario_forensics_io,
 )

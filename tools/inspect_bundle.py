@@ -29,7 +29,11 @@ Summary (the triage view — what a responder needs FIRST after a death):
 - the last ERROR/WARNING journal events (the stderr that got lost);
 - chaos triage (docs/chaos.md): the armed fault plan's seed, the last
   injected fault (seam + context), requeued-batch count, per-executor
-  health states, and the quarantine/re-admission timeline.
+  health states, and the quarantine/re-admission timeline;
+- AOT store triage (docs/aot.md): the store path, the last
+  ``aot.corrupt``/``aot.skew`` events, and the per-entry load outcome
+  timeline (loads/misses/saves) — the first questions after a restart
+  that came up slow or degraded.
 
 ``--json`` prints the summary as one JSON object instead of text
 (bench tooling and tests consume this form).
@@ -192,6 +196,31 @@ def summarize(bundle_dir: str) -> Dict[str, Any]:
         for e in health_events
     ]
     verifier_stats = (inflight_file or {}).get("verifier") or {}
+    # AOT store triage (docs/aot.md): what the durable executable tier
+    # did — per-entry load outcomes plus the last corruption/skew events
+    aot_events = [
+        e for e in events if str(e.get("kind", "")).startswith("aot.")
+    ]
+    aot_summary: Optional[Dict[str, Any]] = None
+    if aot_events:
+        corrupts = [e for e in aot_events if e.get("kind") == "aot.corrupt"]
+        skews = [e for e in aot_events if e.get("kind") == "aot.skew"]
+        store_paths = [e.get("store") for e in aot_events if e.get("store")]
+        aot_summary = {
+            "store": store_paths[-1] if store_paths else None,
+            "loads": sum(1 for e in aot_events if e.get("kind") == "aot.load"),
+            "misses": sum(1 for e in aot_events if e.get("kind") == "aot.miss"),
+            "saves": sum(1 for e in aot_events if e.get("kind") == "aot.save"),
+            "corrupt": len(corrupts),
+            "skew": len(skews),
+            "last_corrupt": corrupts[-1] if corrupts else None,
+            "last_skew": skews[-1] if skews else None,
+            "outcomes": [
+                {k: e.get(k) for k in ("wall", "kind", "entry", "bucket",
+                                       "device", "seconds", "what", "reason")}
+                for e in aot_events[-10:]
+            ],
+        }
     chaos_summary: Optional[Dict[str, Any]] = None
     if injected or requeues or health_events or chaos_manifest:
         chaos_summary = {
@@ -210,6 +239,7 @@ def summarize(bundle_dir: str) -> Dict[str, Any]:
         "pid": manifest.get("pid"),
         "schema": manifest.get("schema"),
         "chaos": chaos_summary,
+        "aot": aot_summary,
         "dump_errors": manifest.get("errors"),
         "journal_events": manifest.get("journal", {}).get("events"),
         "journal_dropped": manifest.get("journal", {}).get("dropped"),
@@ -261,6 +291,22 @@ def _print_text(s: Dict[str, Any]) -> None:
         if ov.get("dropped_by_reason"):
             for reason, n in sorted(ov["dropped_by_reason"].items()):
                 print(f"  shed reason {reason:13s} {n} sets")
+    aot = s.get("aot")
+    if aot:
+        print(f"AOT store  {aot.get('store')}  loads={aot.get('loads')} "
+              f"misses={aot.get('misses')} saves={aot.get('saves')} "
+              f"corrupt={aot.get('corrupt')} skew={aot.get('skew')}")
+        lc = aot.get("last_corrupt")
+        if lc:
+            print(f"  last corrupt  {lc.get('what')} entry={lc.get('entry')} "
+                  f"b{lc.get('bucket')} {lc.get('device')} (wall {lc.get('wall')})")
+        ls = aot.get("last_skew")
+        if ls:
+            print(f"  last skew     {ls.get('reason')} entry={ls.get('entry')} "
+                  f"b{ls.get('bucket')} {ls.get('device')} (wall {ls.get('wall')})")
+        for e in aot.get("outcomes") or []:
+            print(f"  {e.get('wall')}  {e.get('kind'):12s} "
+                  f"{e.get('entry')} b{e.get('bucket')} {e.get('device')}")
     ch = s.get("chaos")
     if ch:
         lf = ch.get("last_fault") or {}

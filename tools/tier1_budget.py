@@ -74,7 +74,8 @@ def analyze(repo: str, cap_s: float = DEFAULT_CAP_S) -> Dict[str, Any]:
             {"wall_s": r.get("wall_s"), "n_tests": r.get("n_tests"),
              "exitstatus": r.get("exitstatus"),
              "compile_events": r.get("compile_events"),
-             "compile_events_s": r.get("compile_events_s")}
+             "compile_events_s": r.get("compile_events_s"),
+             "aot": r.get("aot")}
             for r in runs
         ],
     }
@@ -99,6 +100,7 @@ def analyze(repo: str, cap_s: float = DEFAULT_CAP_S) -> Dict[str, Any]:
         out["movers"] = movers(prev_full.get("tests", {}), last.get("tests", {}))
         if last.get("wall_s") and prev_full.get("wall_s"):
             out["wall_delta_s"] = round(last["wall_s"] - prev_full["wall_s"], 1)
+    out["aot"] = last.get("aot")
     slowest = sorted(
         last.get("tests", {}).items(), key=lambda kv: -kv[1]
     )[:10]
@@ -128,6 +130,14 @@ def render(report: Dict[str, Any]) -> str:
         )
     if report.get("wall_delta_s") is not None:
         lines.append(f"  wall delta vs previous full run: {report['wall_delta_s']:+}s")
+    if report.get("aot"):
+        a = report["aot"]
+        lines.append(
+            f"  AOT executable store (latest run): hits={a.get('hits')} "
+            f"misses={a.get('misses')} saves={a.get('saves')} "
+            f"corrupt={a.get('corrupt')} skew={a.get('skew')} "
+            f"(docs/aot.md — hits skip trace+lower+backend-load entirely)"
+        )
     if report.get("movers"):
         lines.append("  top movers vs previous run:")
         for m in report["movers"]:
