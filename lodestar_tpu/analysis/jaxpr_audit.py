@@ -37,6 +37,22 @@ Rules over the (recursively walked) equation graph:
   the pow2-padded RLC product trees are batch-count-dependent by design
   and each bucket is its own compiled program.
 
+Sharded-entry rule set (the round-11 mesh programs,
+``ops/sharded_verify``): the concat/f64/callback/cache-key rules all
+apply to the ``shard_map``-mapped body (walk_eqns recurses into the
+shard_map jaxpr param like any other sub-jaxpr), plus two rules over the
+body's collective structure:
+
+- ``jaxpr-sharded-no-collective``  a sharded entry whose mapped body
+  contains no cross-shard collective (all_gather/ppermute/psum/...) —
+  each shard would silently verify only its local slice and the "mesh
+  verdict" would be one shard's opinion.
+- ``jaxpr-sharded-local-final-exp``  a final-exponentiation pow-x scan
+  (length ``len(_X_WINDOWS)``, Fq12-shaped carry) appearing BEFORE the
+  body's first collective: final-exp running per SHARD instead of once
+  on the combined product — the serial scan the split/sharded design
+  exists to pay exactly once per merged batch.
+
 ``trace_entry`` is lru-cached per (entry, bucket): the alignment contract
 test, the static-analysis test, and tools/lint.py share one trace — the
 trace of the full fused graph is the expensive part (~15-30 s), so it is
@@ -70,8 +86,25 @@ from .report import Violation
 # since PR 1, so tier-1 traces are shared, not re-spent.
 AUDIT_BUCKETS: Tuple[int, int] = (4, 128)
 
+# Sharded audit shape: global bucket 8 over a 2-device mesh — the local
+# shard body is the bucket-4 graph the single-chip audit already traces,
+# so the incremental trace cost is one extra bucket-4-sized walk per
+# flavor, amortized by the artifact disk cache like everything else.
+SHARDED_AUDIT_BUCKETS: Tuple[int, ...] = (8,)
+SHARDED_AUDIT_MESH = 2
+
 _CALLBACK_PRIMITIVES = ("pure_callback", "io_callback", "debug_callback")
 _WIDE_DTYPES = ("float64", "int64", "uint64", "complex128")
+
+#: cross-shard collective primitives a sharded body must contain
+_COLLECTIVE_PRIMITIVES = (
+    "all_gather", "ppermute", "pshuffle", "psum", "all_reduce",
+    "reduce_scatter", "all_to_all",
+)
+
+#: pow-x window scans one final exponentiation contributes (the x-chain:
+#: y0, y1, y2 and y3's double pow — fused_pairing.final_exponentiation)
+FINAL_EXP_POW_SCANS = 5
 
 
 # ---------------------------------------------------------------------------
@@ -133,12 +166,56 @@ def entry_points() -> Dict[str, dict]:
     }
 
 
+def sharded_audit_available() -> bool:
+    """The sharded entries need a real >= 2-device mesh at trace time
+    (shard_map binds mesh devices); a 1-device host skips them — the
+    8-virtual-device tier-1/conftest environment and tools/lint.py (which
+    forces the host device count) both qualify."""
+    try:
+        import jax
+
+        return len(jax.devices()) >= SHARDED_AUDIT_MESH
+    except Exception:
+        return False
+
+
+@functools.lru_cache(maxsize=1)
+def sharded_entry_points() -> Dict[str, dict]:
+    """name -> {fn, mosaic, sharded}: the round-11 mesh entry points over
+    a SHARDED_AUDIT_MESH-device mesh.  The fused flavor traces with
+    interpret=True (lowering-only difference, no TPU plugin needed) and
+    carries the Mosaic concat rules; the XLA full flavor carries the
+    final-exp placement the full path runs on device."""
+    from ..ops import sharded_verify as sv
+
+    mesh = sv.make_mesh(n_devices=SHARDED_AUDIT_MESH)
+    return {
+        "sharded_verify.miller_product_sharded": {
+            "fn": sv.miller_product_sharded(mesh, fused=True, interpret=True),
+            "mosaic": True,
+            "sharded": True,
+        },
+        "sharded_verify.verify_signature_sets_sharded": {
+            "fn": sv.verify_signature_sets_sharded(mesh, fused=False),
+            "mosaic": False,
+            "sharded": True,
+        },
+    }
+
+
+def _entry_meta(name: str) -> dict:
+    eps = entry_points()
+    if name in eps:
+        return eps[name]
+    return sharded_entry_points()[name]
+
+
 @functools.lru_cache(maxsize=None)
 def trace_entry(name: str, bucket: int):
     """ClosedJaxpr of one entry point at one bucket (cached per process)."""
     import jax
 
-    fn = entry_points()[name]["fn"]
+    fn = _entry_meta(name)["fn"]
     return jax.make_jaxpr(fn)(*_abstract_batch(bucket))
 
 
@@ -179,7 +256,77 @@ def all_eqns(closed_jaxpr) -> List:
 # schema tag folded into the fingerprint alongside a hash of this module's
 # own source (so editing the trace inputs or extraction logic invalidates
 # the cache automatically, no manual bump required)
-_CACHE_VERSION = 1
+_CACHE_VERSION = 2  # v2: sharded collective/final-exp ordering stats
+
+
+def _is_final_exp_scan(eqn) -> bool:
+    """A pow-by-x window scan: length == len(_X_WINDOWS) with an
+    Fq12-shaped ((6, 2, NLIMBS)-trailing) carry — 5 of these per final
+    exponentiation, and nothing else in the verify graphs matches both
+    the length and the carry shape."""
+    if eqn.primitive.name != "scan":
+        return False
+    from ..ops import limbs as fl
+    from ..ops.pairing import _X_WINDOWS
+
+    if eqn.params.get("length") != len(_X_WINDOWS):
+        return False
+    sig = (6, 2, fl.NLIMBS)
+    return any(
+        tuple(getattr(getattr(v, "aval", None), "shape", ()) or ())[-3:] == sig
+        for v in eqn.outvars
+    )
+
+
+def _find_shard_map_bodies(jaxpr, out: List) -> None:
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "shard_map":
+            body = eqn.params.get("jaxpr")
+            if hasattr(body, "eqns"):
+                out.append(body)
+        for v in eqn.params.values():
+            if hasattr(v, "eqns"):
+                _find_shard_map_bodies(v, out)
+            elif hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):
+                _find_shard_map_bodies(v.jaxpr, out)
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    if hasattr(item, "eqns"):
+                        _find_shard_map_bodies(item, out)
+                    elif hasattr(item, "jaxpr") and hasattr(item.jaxpr, "eqns"):
+                        _find_shard_map_bodies(item.jaxpr, out)
+
+
+def _sharded_stats(closed_jaxpr):
+    """Collective/final-exp ordering stats over every shard_map body in
+    the graph (None when there is none).  walk_eqns order is depth-first
+    in body order, so "before the first collective" is a sound program-
+    order statement for the top-level body structure."""
+    bodies: List = []
+    _find_shard_map_bodies(closed_jaxpr.jaxpr, bodies)
+    if not bodies:
+        return None
+    collectives: List[str] = []
+    n_final_exp = 0
+    before_combine = 0
+    for body in bodies:
+        eqns: List = []
+        walk_eqns(body, eqns)
+        seen_collective = False
+        for eqn in eqns:
+            pname = eqn.primitive.name
+            if pname in _COLLECTIVE_PRIMITIVES:
+                collectives.append(pname)
+                seen_collective = True
+            elif _is_final_exp_scan(eqn):
+                n_final_exp += 1
+                if not seen_collective:
+                    before_combine += 1
+    return {
+        "collectives": sorted(set(collectives)),
+        "final_exp_scans": n_final_exp,
+        "final_exp_scans_before_combine": before_combine,
+    }
 
 
 def extract_artifacts(closed_jaxpr) -> dict:
@@ -217,6 +364,7 @@ def extract_artifacts(closed_jaxpr) -> dict:
         "out_avals": [
             [list(a.shape), a.dtype.name] for a in closed_jaxpr.out_avals
         ],
+        "sharded": _sharded_stats(closed_jaxpr),
     }
     # canonicalize through JSON so cold-extracted and cache-loaded
     # artifacts compare equal (tuples -> lists, np ints -> ints)
@@ -413,6 +561,57 @@ def _check_cache_keys(
     return out
 
 
+def check_sharded_rules(name: str, bucket: int, art: dict) -> List[Violation]:
+    """The sharded-entry rule set over one artifact: a mesh entry must
+    actually map through shard_map, its body must combine across shards,
+    and the final exponentiation must follow the combine (once per
+    merged batch, never once per shard)."""
+    sh = art.get("sharded")
+    where = f"{name}@{bucket}"
+    if not sh:
+        return [
+            Violation(
+                "jaxpr-sharded-no-collective", where, 0,
+                "sharded entry traced to a graph with NO shard_map body — "
+                "the mesh wrapper is gone, so the 'sharded' program is a "
+                "single-chip program wearing the mesh's ledger key",
+            )
+        ]
+    out: List[Violation] = []
+    if not sh["collectives"]:
+        out.append(
+            Violation(
+                "jaxpr-sharded-no-collective", where, 0,
+                "shard_map body contains no cross-shard collective "
+                f"({'/'.join(_COLLECTIVE_PRIMITIVES)}) — each shard would "
+                "verify only its local slice and the mesh verdict would "
+                "be one shard's opinion",
+            )
+        )
+    if sh["final_exp_scans_before_combine"]:
+        out.append(
+            Violation(
+                "jaxpr-sharded-local-final-exp", where, 0,
+                f"{sh['final_exp_scans_before_combine']} final-exp pow-x "
+                f"scan(s) run BEFORE the body's first collective — the "
+                f"final exponentiation must run once on the combined "
+                f"product, not once per shard (the serial scan the "
+                f"split/sharded design pays exactly once per batch)",
+            )
+        )
+    if sh["final_exp_scans"] > FINAL_EXP_POW_SCANS:
+        out.append(
+            Violation(
+                "jaxpr-sharded-local-final-exp", where, 0,
+                f"{sh['final_exp_scans']} final-exp pow-x scans in the "
+                f"mapped body (one final exponentiation contributes "
+                f"{FINAL_EXP_POW_SCANS}) — final-exp is running more than "
+                f"once per merged batch",
+            )
+        )
+    return out
+
+
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
@@ -422,7 +621,7 @@ def audit_entry(
     name: str, buckets: Sequence[int] = AUDIT_BUCKETS, use_cache: bool = True
 ) -> List[Violation]:
     """All IR rules for one entry point at every bucket in ``buckets``."""
-    meta = entry_points()[name]
+    meta = _entry_meta(name)
     arts = {b: entry_artifacts(name, b, use_cache) for b in buckets}
     out: List[Violation] = []
     for b in buckets:
@@ -430,6 +629,8 @@ def audit_entry(
             out.extend(_check_concat(name, b, arts[b]))
         out.extend(_check_wide_dtypes(name, b, arts[b]))
         out.extend(_check_callbacks(name, b, arts[b]))
+        if meta.get("sharded"):
+            out.extend(check_sharded_rules(name, b, arts[b]))
     out.extend(_check_cache_keys(name, buckets, arts))
     return out
 
@@ -438,9 +639,15 @@ def audit_all(
     buckets: Sequence[int] = AUDIT_BUCKETS,
     entries: Iterable[str] = None,
     use_cache: bool = True,
+    include_sharded: bool = True,
 ) -> List[Violation]:
     names = list(entries) if entries is not None else list(entry_points())
     out: List[Violation] = []
     for name in names:
         out.extend(audit_entry(name, buckets, use_cache))
+    # the mesh entries audit at their own (global-bucket, mesh) shape —
+    # the caller's single-chip bucket pair does not apply to them
+    if include_sharded and entries is None and sharded_audit_available():
+        for name in sharded_entry_points():
+            out.extend(audit_entry(name, SHARDED_AUDIT_BUCKETS, use_cache))
     return out

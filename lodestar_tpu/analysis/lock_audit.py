@@ -285,6 +285,8 @@ VERIFIER_GUARDED_ATTRS = frozenset(
         "pack_cache_misses",
         "batches_requeued",
         "native_fallbacks",
+        "sharded_batches",
+        "sharded_fallbacks",
     }
 )
 

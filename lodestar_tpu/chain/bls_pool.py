@@ -350,6 +350,26 @@ class BlsBatchPool:
 
     # -- flushing -------------------------------------------------------------
 
+    def _flush_window(self) -> Tuple[int, int]:
+        """(pipeline window, per-batch merge cap) for the current flush
+        pass.  Per-device placement wants ``pipeline_depth`` batches PER
+        chip, each near ``flush_threshold``.  An active sharded tier
+        (docs/multichip.md) grows the MERGE CAP by ``n_devices`` — under
+        storm load one mesh-wide merged batch then absorbs what would
+        otherwise fan out as ``n_devices`` separate placements — while
+        the window stays ``pipeline_depth × n_devices``: light traffic
+        still drains into small sub-mesh batches that ride the
+        per-device pool tier, and shrinking the window for THOSE would
+        idle n-1 chips (the pool cannot know a batch's tier before it is
+        drained and packed).  Re-read every loop iteration — a sharded
+        tier that degrades mid-storm drops the cap back on the next
+        fill."""
+        n_dev = max(1, getattr(self.verifier, "n_devices", 1))
+        max_size = max(self.flush_threshold, 1)
+        if getattr(self.verifier, "sharded_active", False):
+            max_size *= n_dev
+        return self.pipeline_depth * n_dev, max_size
+
     def _buffered_sets_changed(self) -> None:
         if self.metrics:
             self.metrics.bls_pool_queue_length.set(self.pending_sets())
@@ -420,10 +440,13 @@ class BlsBatchPool:
         busy = 0.0  # sum of per-batch pack-start->verdict wall (overlap ratio)
         sets_done = 0  # sets resolved this flush (per-chip throughput gauge)
         # pipeline_depth is per device: a multi-chip executor pool wants
-        # enough batches in flight to keep every chip busy
-        window = self.pipeline_depth * max(1, getattr(self.verifier, "n_devices", 1))
+        # enough batches in flight to keep every chip busy.  With the
+        # sharded tier active the merge cap grows so storm backlogs form
+        # mesh-wide batches — see _flush_window.
+        window, max_size = self._flush_window()
         try:
             while len(self._queue) or inflight:
+                window, max_size = self._flush_window()
                 # fill the window.  max_size keeps each merged batch near
                 # the dispatch-sized flush_threshold even when a storm
                 # backlog sits in the queue — lane priority is only real
@@ -433,7 +456,7 @@ class BlsBatchPool:
                 while len(self._queue) and len(inflight) < window:
                     drained = self._queue.drain_batch(
                         max_items=1024, with_meta=True,
-                        max_size=max(self.flush_threshold, 1),
+                        max_size=max_size,
                     )
                     if not drained:
                         break

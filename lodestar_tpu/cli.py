@@ -113,6 +113,19 @@ def build_parser() -> argparse.ArgumentParser:
             "backends; off: portable XLA-graph kernels)",
         )
         p.add_argument(
+            "--bls-sharded", choices=("auto", "on", "off"), default="auto",
+            help="cross-chip sharded pairing tier: merged batches at the "
+            "bucket ladder's top end ride ONE shard_map program spanning "
+            "the whole --bls-devices pool, final exponentiation once per "
+            "batch (auto: on for multi-device TPU pools; "
+            "docs/multichip.md)",
+        )
+        p.add_argument(
+            "--bls-sharded-min-batch", type=int, default=0,
+            help="smallest merged batch the sharded tier takes "
+            "(0 = the largest --bls-buckets entry)",
+        )
+        p.add_argument(
             "--bls-cache-dir", default=None,
             help="persistent XLA compilation cache directory "
             "(default: $LODESTAR_TPU_JAX_CACHE or repo-local .jax_cache)",
@@ -488,8 +501,12 @@ def _make_verifier(args):
             devices = local if n_dev == 0 else local[:n_dev]
             logger.info("bls executor pool: %d of %d local devices",
                         len(devices), len(local))
+        sharded_flag = getattr(args, "bls_sharded", "auto")
+        sharded = None if sharded_flag == "auto" else sharded_flag == "on"
         v = TpuBlsVerifier(
             buckets=buckets, fused=fused, devices=devices,
+            sharded=sharded,
+            sharded_min_batch=getattr(args, "bls_sharded_min_batch", 0) or None,
             point_cache_size=getattr(args, "bls_point_cache_size", 8192),
             quarantine_threshold=getattr(args, "bls_quarantine_threshold", 2),
             quarantine_backoff_s=getattr(args, "bls_quarantine_backoff_s", 1.0),

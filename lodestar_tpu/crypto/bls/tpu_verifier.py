@@ -77,6 +77,22 @@ def _fused_default() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def _sharded_default(n_devices: int) -> bool:
+    """The cross-chip sharded pairing tier (ops/sharded_verify) is the
+    production top tier on real multi-device TPU pools; elsewhere it is
+    opt-in (a CPU mesh of virtual devices shares the host's cores, so
+    sharding there is a test shape, not a win).
+    LODESTAR_TPU_SHARDED=0/1 overrides."""
+    env = os.environ.get("LODESTAR_TPU_SHARDED")
+    if env is not None:
+        return env not in ("0", "false", "no")
+    if n_devices < 2:
+        return False
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
 _CACHE_CONFIGURED = False
 
 
@@ -326,10 +342,13 @@ class DeviceExecutor:
 
     __slots__ = ("device", "index", "name", "inflight", "compiled", "health")
 
-    def __init__(self, device=None, index: int = 0, backoff_s: float = 1.0):
+    def __init__(self, device=None, index: int = 0, backoff_s: float = 1.0,
+                 name: Optional[str] = None):
         self.device = device  # None = default backend device (unpinned jit)
         self.index = index
-        self.name = (
+        # ``name`` override: the mesh pseudo-executor (the sharded tier's
+        # whole-mesh program slot) has no single device to name itself by
+        self.name = name or (
             f"{device.platform}:{device.id}" if device is not None else "default"
         )
         self.inflight = 0
@@ -381,6 +400,9 @@ class TpuBlsVerifier:
         devices: Optional[Sequence] = None,
         host_final_exp: bool = True,
         fused: Optional[bool] = None,
+        sharded: Optional[bool] = None,
+        sharded_min_batch: Optional[int] = None,
+        sharded_combine: str = "all_gather",
         metrics=None,
         point_cache_size: int = 8192,
         quarantine_threshold: int = 2,
@@ -398,6 +420,16 @@ class TpuBlsVerifier:
         # production dispatch on TPU; resolved lazily so constructing a
         # verifier never touches a JAX backend.
         self.fused = fused
+        # round-11 sharded tier (docs/multichip.md): ONE shard_map
+        # program spans the whole device pool for merged batches >=
+        # ``sharded_min_batch`` (default: the bucket ladder's top end)
+        # whose bucket divides evenly across the mesh.  None = auto (on
+        # for multi-device TPU pools; LODESTAR_TPU_SHARDED overrides),
+        # resolved lazily like ``fused``.  ``sharded_combine`` picks the
+        # GT cross-chip reduction topology (all_gather | ring).
+        self.sharded = sharded
+        self.sharded_min_batch = sharded_min_batch
+        self.sharded_combine = sharded_combine
         self.metrics = metrics
         # self-healing pool knobs (docs/chaos.md): consecutive failures
         # before quarantine, the first backoff, and the doubling cap
@@ -430,6 +462,14 @@ class TpuBlsVerifier:
             ]
         else:
             self._executors = [DeviceExecutor(None, 0, backoff_s=quarantine_backoff_s)]
+        # the mesh pseudo-executor: holds the whole-mesh sharded programs
+        # and the health record the self-healing machinery steers the
+        # sharded tier by.  NOT in the placement rotation — a mesh batch
+        # spans every chip, there is nothing to least-load.
+        self._mesh_ex = DeviceExecutor(
+            None, -1, backoff_s=quarantine_backoff_s,
+            name=f"mesh{len(self._executors)}",
+        )
         self._sched_lock = threading.Lock()
         self._rr = 0  # round-robin tie-break cursor
         self.point_cache = PointCache(point_cache_size)
@@ -451,6 +491,8 @@ class TpuBlsVerifier:
         self.pack_cache_misses = 0
         self.batches_requeued = 0    # failed batches re-dispatched to survivors
         self.native_fallbacks = 0    # verdicts served by the host-native tier
+        self.sharded_batches = 0     # batches dispatched as one mesh program
+        self.sharded_fallbacks = 0   # sharded-tier hops down to the pool tier
         self.stage_seconds = {"pack": 0.0, "dispatch": 0.0, "final_exp": 0.0, "warmup": 0.0}
         # rate limit for the automatic diagnostic bundles the self-healing
         # events write (one per reason per cooldown — a persistently sick
@@ -477,7 +519,10 @@ class TpuBlsVerifier:
         health endpoint, and the chaos campaign all read this)."""
         now = time.monotonic()
         with self._sched_lock:
-            return {ex.name: ex.health.snapshot(now) for ex in self._executors}
+            out = {ex.name: ex.health.snapshot(now) for ex in self._executors}
+            if self.sharded and self.n_devices > 1:
+                out[self._mesh_ex.name] = self._mesh_ex.health.snapshot(now)
+            return out
 
     # -- compilation cache ---------------------------------------------------
 
@@ -485,6 +530,142 @@ class TpuBlsVerifier:
         if self.fused is None:
             self.fused = _fused_default()
         return self.fused
+
+    # -- sharded tier: one shard_map program spans the mesh ------------------
+
+    def _resolve_sharded(self) -> bool:
+        if self.sharded is None:
+            self.sharded = _sharded_default(self.n_devices)
+        return self.sharded
+
+    @property
+    def sharded_active(self) -> bool:
+        """True when the sharded tier can take batches — the pool reads
+        this to size its flush window (one mesh-wide merged batch absorbs
+        what would otherwise fan out as n_devices placements)."""
+        if self.n_devices < 2 or self._native_tier_only:
+            return False
+        return self._resolve_sharded()
+
+    def _sharded_min(self) -> int:
+        return self.sharded_min_batch or self.buckets[-1]
+
+    def _sharded_buckets(self, bucket_list) -> list:
+        return [
+            b for b in bucket_list
+            if b >= self._sharded_min() and b % self.n_devices == 0
+        ]
+
+    def _sharded_eligible(self, n: int) -> bool:
+        """Does THIS packed bucket ride the mesh?  Size gate (the bucket
+        ladder's top end, evenly divisible across the chips) plus the
+        same self-healing eligibility the per-device executors get: a
+        quarantined mesh sits out its backoff, then ONE idle probe batch
+        decides re-admission."""
+        if self.n_devices < 2 or not self._resolve_sharded():
+            return False
+        if n < self._sharded_min() or n % self.n_devices:
+            return False
+        now = time.monotonic()
+        with self._sched_lock:
+            return self._eligible_locked(self._mesh_ex, now)
+
+    @staticmethod
+    def _maybe_probe_locked(ex: DeviceExecutor, now: float) -> bool:
+        """QUARANTINED -> PROBING flip (caller holds ``_sched_lock``).
+        One implementation for the per-device acquire AND the mesh
+        acquire, so the state machine cannot diverge between them."""
+        h = ex.health
+        if h.state == QUARANTINED and now >= h.quarantined_until:
+            h.state = PROBING
+            h.changed_monotonic = now
+            return True
+        return False
+
+    def _note_probe_transition(self, ex: DeviceExecutor) -> None:
+        """Post-lock half of the probe transition: journal + health
+        metric (leaf-lock discipline — never under ``_sched_lock``)."""
+        JOURNAL.record("bls.health", device=ex.name, state=PROBING,
+                       failures=ex.health.failures,
+                       backoff_s=round(ex.health.backoff_s, 3))
+        self._set_health_metric(ex)
+
+    def _acquire_mesh(self) -> DeviceExecutor:
+        """The mesh pseudo-executor's slot acquire: same quarantine ->
+        probe transition as _acquire_executor, no placement choice (a
+        mesh batch spans every chip)."""
+        now = time.monotonic()
+        with self._sched_lock:
+            ex = self._mesh_ex
+            probing = self._maybe_probe_locked(ex, now)
+            ex.inflight += 1
+            inflight = ex.inflight
+        if probing:
+            self._note_probe_transition(ex)
+        if self.metrics:
+            self.metrics.bls_device_inflight.labels(device=ex.name).set(inflight)
+        return ex
+
+    def _mesh_entry_name(self) -> str:
+        """Compile-ledger / AOT-store entry label for the mesh program.
+        Paired with the ``mesh{k}`` device label it makes the program
+        ledger as ONE entry — never k per-ordinal rows."""
+        return "sharded_split" if self.host_final_exp else "sharded_full"
+
+    def _mesh_memo_key(self, key):
+        dev_ids = tuple(
+            (d.platform, d.id) for d in (self.devices or ())
+        )
+        return (("sharded",) + key, dev_ids, self.sharded_combine)
+
+    def _aot_load_mesh(self, bucket: int):
+        """AOT-store lookup for the mesh program (mesh{k}-keyed)."""
+        return self._aot_load_program(
+            self._mesh_entry_name(), bucket, self._mesh_ex.name
+        )
+
+    def _mesh_fn(self, n: int):
+        """Materialization ladder for the whole-mesh sharded program:
+        in-process memo -> durable AOT store (``mesh{k}`` key) ->
+        persistent .jax_cache -> cold compile.  ONE program per bucket
+        for the whole mesh — the compile is paid once per fleet via the
+        prewarm farm's --mesh mode, not once per ordinal."""
+        import jax
+
+        fused = self._resolve_fused()
+        key = (n, self.host_final_exp, fused)
+        ex = self._mesh_ex
+        if key not in ex.compiled:
+            mk = self._mesh_memo_key(key)
+            with _PROGRAM_MEMO_LOCK:
+                fn = _PROGRAM_MEMO.get(mk)
+            if fn is None:
+                fn = self._aot_load_mesh(n)
+            if fn is None:
+                if self.load_only:
+                    raise AotStoreMiss(
+                        f"load-only verifier: no stored executable for "
+                        f"{self._mesh_entry_name()} bucket {n} on {ex.name}"
+                    )
+                from ...ops import sharded_verify as sharded
+
+                mesh = sharded.make_mesh(self.devices)
+                factory = (
+                    sharded.miller_product_sharded if self.host_final_exp
+                    else sharded.verify_signature_sets_sharded
+                )
+                kernel = factory(mesh, fused=fused,
+                                 combine=self.sharded_combine)
+                store = self._get_aot_store()
+                if store is not None:
+                    fn = jax.jit(kernel).lower(*self._abstract_args(n)).compile()
+                    store.save(self._mesh_entry_name(), n, ex.name, fn)
+                else:
+                    fn = jax.jit(kernel)
+            with _PROGRAM_MEMO_LOCK:
+                fn = _PROGRAM_MEMO.setdefault(mk, fn)
+            ex.compiled[key] = fn
+        return ex.compiled[key]
 
     def _kernel(self, key):
         """Python kernel callable for a (n, host_final_exp, fused) key."""
@@ -535,24 +716,28 @@ class TpuBlsVerifier:
             store.configure()
         return store if store.enabled else None
 
-    def _aot_load(self, key, bucket: int, ex: DeviceExecutor):
-        """One store lookup for (key, executor): a hit is ledgered as the
-        ``aot_load`` kind (flagging the enclosing attribution window when
-        dispatch owns one, recording directly from warmup otherwise).
-        Misses/corruption/skew are the store's problem — every failure
-        journals there and returns None here."""
+    def _aot_load_program(self, entry: str, bucket: int, device: str):
+        """One store lookup: a hit is ledgered as the ``aot_load`` kind
+        (flagging the enclosing attribution window when dispatch owns
+        one, recording directly from warmup otherwise).  Shared by the
+        per-device and mesh tiers — only the (entry, device) labels
+        differ.  Misses/corruption/skew are the store's problem — every
+        failure journals there and returns None here."""
         store = self._get_aot_store()
         if store is None:
             return None
-        entry = _entry_name(key)
         t0 = time.perf_counter()
-        fn = store.load(entry, bucket, ex.name)
+        fn = store.load(entry, bucket, device)
         if fn is not None:
             COMPILE_LEDGER.note_aot_load(
                 time.perf_counter() - t0, entry=entry, bucket=bucket,
-                device=ex.name,
+                device=device,
             )
         return fn
+
+    def _aot_load(self, key, bucket: int, ex: DeviceExecutor):
+        """Per-device store lookup for a (n, host_final_exp, fused) key."""
+        return self._aot_load_program(_entry_name(key), bucket, ex.name)
 
     def _aot_save(self, key, bucket: int, ex: DeviceExecutor, compiled) -> None:
         """Best-effort persist of a freshly-compiled executable (the
@@ -649,18 +834,13 @@ class TpuBlsVerifier:
                         (eligible[(start + i) % n_el] for i in range(n_el)),
                         key=lambda e: e.inflight,
                     )
-            h = ex.health
-            if h.state == QUARANTINED and now >= h.quarantined_until:
-                h.state = PROBING
-                h.changed_monotonic = now
-                transitions.append((ex, PROBING, h.failures, h.backoff_s))
+            if self._maybe_probe_locked(ex, now):
+                transitions.append(ex)
             ex.inflight += 1
             inflight = ex.inflight
-        for t_ex, state, failures, backoff in transitions:
+        for t_ex in transitions:
             # journal outside the scheduler lock (leaf-lock discipline)
-            JOURNAL.record("bls.health", device=t_ex.name, state=state,
-                           failures=failures, backoff_s=round(backoff, 3))
-            self._set_health_metric(t_ex)
+            self._note_probe_transition(t_ex)
         if self.metrics:
             self.metrics.bls_device_inflight.labels(device=ex.name).set(inflight)
         return ex
@@ -967,6 +1147,62 @@ class TpuBlsVerifier:
                         return self._warmup_tier(bucket_list, load_only)
         return missing
 
+    def _warmup_sharded_tier(self, bucket_list, load_only: bool) -> int:
+        """Mesh-program pass of warmup(): memo -> mesh{k}-keyed AOT
+        store -> (unless ``load_only``) compile + store save, for every
+        mesh-eligible bucket.  A failure (compile, or a load-only store
+        miss) hops the sharded tier down to the per-device pool with
+        exactly one ``bls.degrade`` — the pool tiers keep their own
+        ladder, so the node comes up either way.  Returns the number of
+        mesh programs materialized."""
+        if self.n_devices < 2 or self._native_tier_only:
+            return 0
+        if not self._resolve_sharded():
+            return 0
+        warmed = 0
+        for b in self._sharded_buckets(bucket_list):
+            try:
+                if CHAOS.armed and not load_only:
+                    CHAOS.maybe_raise(
+                        "bls.compile", where="warmup",
+                        device=self._mesh_ex.name, bucket=b,
+                        fused=self._resolve_fused(), sharded=True,
+                    )
+                with COMPILE_LEDGER.attribute(
+                    self._mesh_entry_name(), bucket=b,
+                    device=self._mesh_ex.name,
+                ):
+                    # load_only: _mesh_fn stops after the store tier and
+                    # raises AotStoreMiss — the degrade arm below owns it
+                    self._mesh_fn(b)
+                warmed += 1
+            except Exception as e:  # noqa: BLE001
+                tier = "fused" if self._resolve_fused() else "xla"
+                self._degrade(where="warmup", tier=tier, bucket=b,
+                              device=self._mesh_ex.name, error=e)
+                self.sharded = False
+                with self._stats_lock:
+                    self.sharded_fallbacks += 1
+                break
+        return warmed
+
+    def warmup_sharded(self, buckets: Optional[Sequence[int]] = None,
+                       load_only: Optional[bool] = None) -> float:
+        """Materialize ONLY the whole-mesh sharded programs — the
+        prewarm farm's ``--mesh`` mode: one program per eligible bucket
+        for the whole mesh, ledgered and stored under the single
+        ``mesh{k}`` key (never once per ordinal).  Returns wall seconds."""
+        if load_only is None:
+            load_only = self.load_only
+        t0 = time.perf_counter()
+        bucket_list = tuple(buckets if buckets is not None else self.buckets)
+        warmed = self._warmup_sharded_tier(bucket_list, load_only)
+        dt = time.perf_counter() - t0
+        JOURNAL.record("bls.warmup", seconds=round(dt, 3), sharded=True,
+                       mesh_programs=warmed, devices=self.n_devices,
+                       load_only=load_only or None)
+        return dt
+
     def warmup(self, buckets: Optional[Sequence[int]] = None,
                load_only: Optional[bool] = None) -> float:
         """Materialize the dispatch program for every bucket of the
@@ -1010,6 +1246,9 @@ class TpuBlsVerifier:
                           f"program(s) in load-only warmup",
                 )
                 self._native_tier_only = True
+        # the mesh tier warms AFTER the per-device pool: its degrade
+        # target (the pool programs) must already be materialized
+        self._warmup_sharded_tier(bucket_list, load_only)
         dt = time.perf_counter() - t0
         with self._stats_lock:
             self.stage_seconds["warmup"] += dt
@@ -1161,7 +1400,36 @@ class TpuBlsVerifier:
         original signature sets, optional) lets a failed verdict walk
         the rest of the ladder: requeue onto a surviving executor, then
         the host-native tier.  ``_attempt``/``_exclude`` are the requeue
-        path's generation counter and just-failed executor."""
+        path's generation counter and just-failed executor.
+
+        Top of the ladder (round 11): a mesh-eligible bucket — the
+        ladder's top end, evenly divisible across a multi-device pool —
+        rides ONE shard_map program spanning every chip instead of a
+        single-chip placement.  A sharded failure to even enqueue hops
+        down to this per-device path with exactly one ``bls.degrade``;
+        a requeue (``_attempt > 0``) never re-enters the mesh (the
+        replay's job is a surviving executor, not the tier that just
+        failed)."""
+        if _attempt == 0 and _exclude is None and self._sharded_eligible(
+            packed[0].shape[0]
+        ):
+            try:
+                return self._dispatch_sharded(packed, deadline=deadline,
+                                              sets=sets)
+            except Exception as e:  # noqa: BLE001 — hop down to the pool tier
+                tier = "fused" if self._resolve_fused() else "xla"
+                self._degrade(where="dispatch", tier=tier,
+                              bucket=packed[0].shape[0],
+                              device=self._mesh_ex.name, error=e)
+                self.sharded = False
+                with self._stats_lock:
+                    self.sharded_fallbacks += 1
+                # drop the broken mesh program so a later verifier (or a
+                # re-enabled tier) retries it fresh
+                key = (packed[0].shape[0], self.host_final_exp, self.fused)
+                self._mesh_ex.compiled.pop(key, None)
+                with _PROGRAM_MEMO_LOCK:
+                    _PROGRAM_MEMO.pop(self._mesh_memo_key(key), None)
         live = int(np.sum(np.asarray(packed[6])))
         with self._stats_lock:
             self.dispatches += 1
@@ -1286,9 +1554,105 @@ class TpuBlsVerifier:
                               packed=packed, sets=sets, executor=ex,
                               attempt=_attempt, fault=fault)
 
+    def _dispatch_sharded(self, packed, deadline: Optional[float] = None,
+                          sets=None) -> PendingVerdict:
+        """One mesh-spanning dispatch: the whole packed batch sharded
+        over every pool device by the shard_map program — per-pair
+        Miller loops run locally per chip, the GT partial products
+        combine across the mesh, and the final exponentiation runs once
+        per merged batch (docs/multichip.md).
+
+        Identity discipline: the ledger attribution, the AOT store key,
+        the journal/trace device, and the in-flight table entry all use
+        the single ``mesh{k}`` label — one program, one ledger row, one
+        span.  The dispatch span additionally carries ``sharded`` and
+        ``mesh_devices`` so tools/check_trace.py can hold a mesh dump to
+        the mesh contract.  A sync-time failure (device loss mid-batch)
+        rides the normal PendingVerdict recovery: the mesh health record
+        takes the failure (quarantine -> backoff -> probe re-admission)
+        and the SAME packed payload requeues onto a single surviving
+        executor — zero verdicts lost."""
+        n = packed[0].shape[0]
+        live = int(np.sum(np.asarray(packed[6])))
+        t0_ns = TRACER.now()
+        used_fused = self._resolve_fused()
+        ex = self._acquire_mesh()
+        t_disp = time.perf_counter()
+        try:
+            # chaos seam: an injected mesh compile failure surfaces
+            # exactly where a real Mosaic/XLA/collective one would
+            if CHAOS.armed:
+                CHAOS.maybe_raise(
+                    "bls.compile", where="dispatch", device=ex.name,
+                    bucket=n, fused=used_fused, sharded=True,
+                )
+            with COMPILE_LEDGER.attribute(
+                self._mesh_entry_name(), bucket=n, device=ex.name
+            ):
+                out = self._mesh_fn(n)(*packed)
+        except Exception:
+            self._release_executor(ex)
+            # enqueue-time failure is a TIER problem (compile, store,
+            # lowering), not chip sickness: dispatch()'s fallthrough owns
+            # the degrade; the mesh health record is reserved for
+            # sync-time device faults
+            raise
+        with self._stats_lock:
+            self.dispatches += 1
+            self.sets_verified += live
+            self.sharded_batches += 1
+        dt_disp = time.perf_counter() - t_disp
+        with self._stats_lock:
+            self.stage_seconds["dispatch"] += dt_disp
+        if self.metrics:
+            self.metrics.bls_verifier_stage_duration_seconds.labels(
+                stage="dispatch"
+            ).observe(dt_disp)
+            self.metrics.bls_sharded_batches_total.inc()
+        cid = current_batch_id()
+        if TRACER.enabled:
+            TRACER.add_span("bls.dispatch", "bls", t0_ns,
+                            cid=cid, bucket=n, fused=used_fused,
+                            device=ex.name, devices_total=self.n_devices,
+                            sharded=True, mesh_devices=self.n_devices)
+        headroom = None
+        if deadline is not None:
+            headroom = round(deadline - time.monotonic(), 3)
+        if JOURNAL.enabled:
+            JOURNAL.record("bls.dispatch", cid=cid, device=ex.name, bucket=n,
+                           sets=live, fused=used_fused, sharded=True,
+                           mesh_devices=self.n_devices,
+                           inflight=ex.inflight,
+                           devices_total=self.n_devices,
+                           deadline_headroom_s=headroom)
+        token = INFLIGHT.register(cid=cid, device=ex.name, bucket=n, sets=live,
+                                  deadline_s=headroom)
+
+        def release():
+            INFLIGHT.resolve(token)
+            self._release_executor(ex)
+
+        fault = None
+        if CHAOS.armed:
+            fault = (
+                CHAOS.fire("device.loss", device=ex.name, bucket=n, cid=cid)
+                or CHAOS.fire("device.wedge", device=ex.name, bucket=n, cid=cid)
+            )
+        if self.host_final_exp:
+            f, ok = out
+            return PendingVerdict(verifier=self, f=f, ok=ok, release=release,
+                                  device=ex.name, deadline=deadline,
+                                  packed=packed, sets=sets, executor=ex,
+                                  attempt=0, fault=fault)
+        return PendingVerdict(verifier=self, out=out, release=release,
+                              device=ex.name, deadline=deadline,
+                              packed=packed, sets=sets, executor=ex,
+                              attempt=0, fault=fault)
+
     def close(self) -> None:
         for ex in self._executors:
             ex.compiled.clear()
+        self._mesh_ex.compiled.clear()
 
     # -- packing -------------------------------------------------------------
 

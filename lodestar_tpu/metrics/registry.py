@@ -256,6 +256,13 @@ class Metrics:
             "pool flush (sets/wall, NOT divided by device count) — the "
             "headline the sharded-kernel roadmap item is measured against",
         )
+        self.bls_sharded_batches_total = r.counter(
+            "lodestar_bls_sharded_batches_total",
+            "merged batches dispatched as ONE mesh-spanning shard_map "
+            "program (the sharded verifier tier, docs/multichip.md) — "
+            "zero on a busy multi-device pool means big batches are "
+            "fanning out per-device instead of using the whole mesh",
+        )
         # chaos campaign & self-healing device pool (round 12, docs/chaos.md)
         self.bls_degrade_total = r.counter(
             "lodestar_bls_degrade_total",

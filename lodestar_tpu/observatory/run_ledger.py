@@ -38,6 +38,10 @@ TRIPWIRES: Dict[str, Tuple[int, float]] = {
     "bls_sig_sets_per_s_per_chip": (+1, 0.10),
     "bls_sig_sets_per_s": (+1, 0.10),
     "scaling_efficiency": (+1, 0.10),
+    # round-11 sharded tier: the whole-mesh rate of ONE mesh-spanning
+    # batch, and its near-linear-scaling target (ISSUE 10: -10%)
+    "bls_sig_sets_per_s_sharded": (+1, 0.10),
+    "scaling_efficiency_sharded": (+1, 0.10),
     "cold_start_warm_s": (-1, 0.25),
     "cold_start_aot_s": (-1, 0.25),
     "cold_start_cold_s": (-1, 0.25),
@@ -109,6 +113,12 @@ def extract_metrics(run: dict) -> Dict[str, Optional[float]]:
         "bls_sig_sets_per_s": mc.get("bls_sig_sets_per_s")
         or mc.get("sets_per_sec_total"),
         "scaling_efficiency": mc.get("scaling_efficiency"),
+        "bls_sig_sets_per_s_sharded": _get(
+            mc, "sharded", "bls_sig_sets_per_s"
+        ),
+        "scaling_efficiency_sharded": _get(
+            mc, "sharded", "scaling_efficiency"
+        ),
         "cold_start_warm_s": cs.get("warm_s"),
         "cold_start_aot_s": cs.get("aot_s"),
         "cold_start_cold_s": cs.get("cold_s"),

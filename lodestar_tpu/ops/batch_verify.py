@@ -121,6 +121,26 @@ def miller_product_kernel(
     Returns (f, ok) with f: (6, 2, 50) digits of the masked Miller
     product and ok: scalar bool (subgroup checks passed AND any live lane).
     """
+    f, subgroup_ok, any_live = miller_product_parts_kernel(
+        pk_x, pk_y, sig_x, sig_y, msg_u, coeff_bits, mask
+    )
+    return f, subgroup_ok & any_live
+
+
+def miller_product_parts_kernel(
+    pk_x: jnp.ndarray,
+    pk_y: jnp.ndarray,
+    sig_x: jnp.ndarray,
+    sig_y: jnp.ndarray,
+    msg_u: jnp.ndarray,
+    coeff_bits: jnp.ndarray,
+    mask: jnp.ndarray,
+) -> tuple:
+    """Shard-local variant: (f, subgroup_ok, any_live) with the verdict
+    bits uncombined — ops/sharded_verify maps this over a device mesh,
+    where an all-padding shard (any_live False, masked product 1) must
+    not veto the merged batch; the cross-shard combine is
+    ``all(subgroup_ok) & any(any_live)``."""
     n = pk_x.shape[0]
 
     sig_jac = pts.point_from_affine(sig_x, sig_y, FQ2_NS)
@@ -151,7 +171,7 @@ def miller_product_kernel(
     pair_mask = jnp.concatenate([mask, s_not_inf[None]], axis=0)
 
     f = kp.multi_miller_product(xp, yp, g2_aff_x, g2_aff_y, pair_mask)
-    return f, subgroup_ok & jnp.any(mask)
+    return f, subgroup_ok, jnp.any(mask)
 
 
 def example_inputs(n: int = 8) -> tuple:

@@ -249,6 +249,14 @@ def multi_miller_product(xp, yp, xq, yq, mask, interpret=None) -> LV:
         jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), f.a.shape).astype(jnp.float32)
     )
     f = f12_select(mask, f, one)
+    return f12_product_tree(f, interpret)
+
+
+def f12_product_tree(f: LV, interpret=None) -> LV:
+    """prod over the leading axis of a stacked Fq12 LV — the pow2-padded
+    pairwise tree (pad rows are FQ12_ONE through the aligned splice).
+    Factored out so the cross-chip GT combine (ops/sharded_verify) runs
+    the exact tree the single-chip product uses."""
     n = f.a.shape[0]
     npow = 1 << max(0, (n - 1).bit_length())
     if npow != n:

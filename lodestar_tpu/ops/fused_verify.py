@@ -121,6 +121,30 @@ def miller_product_fused(
     """Split entry point: returns (f, ok) with f the masked Miller product
     LV (loose digits) and ok = subgroup checks passed AND any live lane.
     batch_verify.miller_product_kernel twin."""
+    f, subgroup_ok, any_live = miller_product_parts(
+        pk_x, pk_y, sig_x, sig_y, msg_u, coeff_bits, mask, interpret
+    )
+    return f, subgroup_ok & any_live
+
+
+def miller_product_parts(
+    pk_x: jnp.ndarray,
+    pk_y: jnp.ndarray,
+    sig_x: jnp.ndarray,
+    sig_y: jnp.ndarray,
+    msg_u: jnp.ndarray,
+    coeff_bits: jnp.ndarray,
+    mask: jnp.ndarray,
+    interpret: bool = False,
+):
+    """The shard-local split of the fused Miller product: returns
+    (f, subgroup_ok, any_live) with the two verdict bits UNCOMBINED.
+
+    This is the body ops/sharded_verify maps over the mesh — a shard
+    whose slice is all padding has ``any_live == False`` but must not
+    veto the mesh verdict (its masked product contributes 1), so the
+    cross-shard combine needs ``all(subgroup_ok) & any(any_live)``
+    rather than an AND over the fused per-shard verdicts."""
     ns1 = fq_ns(interpret)
     ns2 = fq2_ns(interpret)
     n = pk_x.shape[0]
@@ -212,7 +236,7 @@ def miller_product_fused(
     pair_mask = aligned_splice([mask, s_not_inf[None]], axis=0)
 
     f = multi_miller_product(xp, yp, g2_aff_x, g2_aff_y, pair_mask, interpret)
-    return f, subgroup_ok & jnp.any(mask)
+    return f, subgroup_ok, jnp.any(mask)
 
 
 def _affine_with_zinv(p: Point, zinv: LV, ns, interpret=None):

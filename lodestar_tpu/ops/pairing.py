@@ -261,12 +261,20 @@ def multi_miller_product(xp, yp, xq, yq, mask):
     f = miller_loop(xp, yp, xq, yq)  # (N, ..., 6, 2, 50)
     one = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE), f.shape).astype(fl.DTYPE)
     f = tw.fq12_select(mask, f, one)
-    # pairwise product tree over axis 0, padded to a power of two ONCE
-    # with FQ12_ONE rows through an offset-0 aligned splice (zero-pad both
-    # operands to the full extent and add — disjoint supports, exact).
-    # The old per-level odd-size concatenate spliced a single (6,2,50) row
-    # at sublane offset n, the narrow-width retile Mosaic rejects when
-    # this graph is inlined into a fused TPU program (BENCH_r05 rc=124).
+    return fq12_product_tree(f)
+
+
+def fq12_product_tree(f):
+    """prod over the leading axis of stacked Fq12 digit arrays.
+
+    Pairwise product tree over axis 0, padded to a power of two ONCE
+    with FQ12_ONE rows through an offset-0 aligned splice (zero-pad both
+    operands to the full extent and add — disjoint supports, exact).
+    The old per-level odd-size concatenate spliced a single (6,2,50) row
+    at sublane offset n, the narrow-width retile Mosaic rejects when
+    this graph is inlined into a fused TPU program (BENCH_r05 rc=124).
+    Factored out so the cross-chip GT combine (ops/sharded_verify) runs
+    the exact tree the single-chip product uses."""
     n = f.shape[0]
     npow = 1 << max(0, (n - 1).bit_length())
     if npow != n:

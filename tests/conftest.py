@@ -87,6 +87,11 @@ COMPILE_WHITELIST = (
     "tests/test_dev_chain_tpu.py::*",
     "tests/test_multidevice_scheduler.py::*",
     "tests/test_rfc9380_vectors.py::TestHashToG2Device::*",
+    # slow-marked ONLY (tier-1 filters them): real mesh programs for the
+    # sharded-tier oracle/equivalence pins; the module's tier-1 subset is
+    # stub/artifact-riding and stays under the guard
+    "tests/test_sharded_verify.py::TestCombineOracleEquivalence::*",
+    "tests/test_sharded_verify.py::TestShardedEntryEquivalence::*",
 )
 
 

@@ -157,10 +157,13 @@ def make_sets(n: int, start: int = 0, key_mod: int = 8) -> List[Any]:
 
 def stub_verifier(n_devices: int = 4, device_s: float = 0.01,
                   backoff_s: float = 0.25, threshold: int = 2,
-                  fused: bool = False):
+                  fused: bool = False, sharded: bool = False,
+                  bucket: int = 4):
     """Real TpuBlsVerifier with stub device programs on every executor
     (and, when ``fused``, under the fused program key too so the ladder
-    scenario has a working fused path to fail)."""
+    scenario has a working fused path to fail).  ``sharded`` stubs the
+    mesh pseudo-executor as well, so the round-11 mesh tier routes for
+    ``bucket``-sized merged batches with zero XLA work."""
     import jax
 
     from lodestar_tpu.crypto.bls.tpu_verifier import TpuBlsVerifier
@@ -168,15 +171,20 @@ def stub_verifier(n_devices: int = 4, device_s: float = 0.01,
     local = jax.devices("cpu")
     devices = local[: min(n_devices, len(local))] if n_devices > 1 else None
     v = TpuBlsVerifier(
-        buckets=(4,), devices=devices, fused=fused, host_final_exp=False,
+        buckets=(bucket,), devices=devices, fused=fused, host_final_exp=False,
         quarantine_threshold=threshold, quarantine_backoff_s=backoff_s,
         native_verifier=_StubNative(),
+        sharded=sharded or None, sharded_min_batch=bucket if sharded else None,
     )
     for ex in v._executors:
         for key_fused in ((False, True) if fused else (False,)):
-            ex.compiled[(4, False, key_fused)] = (
+            ex.compiled[(bucket, False, key_fused)] = (
                 lambda *a: _SlowVerdict(time.monotonic() + device_s)
             )
+    if sharded:
+        v._mesh_ex.compiled[(bucket, False, False)] = (
+            lambda *a: _SlowVerdict(time.monotonic() + device_s)
+        )
     return v
 
 
@@ -384,6 +392,119 @@ def scenario_device_loss(seed: int, out_dir: str, inspect_bundle,
     tracing.write_chrome_trace(tracing.TRACER, trace_path)
     if check_trace.main([trace_path, "--require-pipeline", "2"]) != 0:
         failures.append("trace with requeued batches failed --require-pipeline")
+    res["trace"] = trace_path
+
+    if failures:
+        res.setdefault("failures", []).extend(failures)
+    res["ok"] = not res.get("failures")
+    return res
+
+
+def scenario_sharded_loss(seed: int, out_dir: str, inspect_bundle,
+                          check_trace, fast: bool) -> Dict[str, Any]:
+    """Round-11 acceptance class: device.loss DURING a mesh-spanning
+    sharded batch.  The verdict must still resolve (same packed payload
+    requeued onto one surviving executor — zero verdicts lost), the mesh
+    health record must quarantine and later re-admit via the backoff
+    probe, and the trace — mesh dispatch spans included — must pass
+    check_trace's pipeline + mesh rules."""
+    res: Dict[str, Any] = {"name": "sharded_loss"}
+    v = stub_verifier(backoff_s=0.25, threshold=1, sharded=True, bucket=8)
+    from lodestar_tpu.chain.bls_pool import BlsBatchPool
+
+    pool = BlsBatchPool(v, max_buffer_wait=0.002, flush_threshold=8,
+                        pipeline_depth=2)
+    RECORDER.configure(forensics_dir=out_dir, pool=pool, verifier=v)
+    tracing.TRACER.clear()
+    tracing.enable(16384)
+    target = v._mesh_ex.name
+    seq0 = JOURNAL.seq
+
+    async def main():
+        baseline = await run_jobs(pool, 8 if fast else 16, sets_per_job=4)
+        CHAOS.install(
+            FaultPlan(seed).add("device.loss", match={"device": target},
+                                count=1)
+        )
+        under_fault = await run_jobs(pool, 8 if fast else 16, sets_per_job=4)
+        healed, heal_stats = await _heal(pool, v)
+        recovered = await run_jobs(pool, 8 if fast else 16, sets_per_job=4)
+        return baseline, under_fault, healed, heal_stats, recovered
+
+    try:
+        baseline, under_fault, healed, heal_stats, recovered = asyncio.run(main())
+    finally:
+        CHAOS.disarm()
+        pool.close()
+        tracing.TRACER.disable()
+
+    events = _journal_since(seq0)
+    quarantine = _first(
+        events,
+        lambda e: e.get("kind") == "bls.health"
+        and e.get("state") == "quarantined" and e.get("device") == target,
+    )
+    readmit = _first(
+        events,
+        lambda e: e.get("kind") == "bls.health" and e.get("readmitted")
+        and e.get("device") == target,
+    )
+    requeues = [
+        e for e in events
+        if e.get("kind") == "bls.requeue" and e.get("from_device") == target
+    ]
+    mesh_dispatches = [
+        e for e in events
+        if e.get("kind") == "bls.dispatch" and e.get("sharded")
+    ]
+
+    res["verdicts_lost"] = (
+        baseline["verdicts_lost"] + under_fault["verdicts_lost"]
+        + heal_stats["verdicts_lost"] + recovered["verdicts_lost"]
+    )
+    res["errors"] = (
+        baseline["errors"] + under_fault["errors"]
+        + heal_stats["errors"] + recovered["errors"]
+    )
+    res["mesh_batches"] = len(mesh_dispatches)
+    res["requeued_batches"] = len(requeues)
+    res["sharded_fallbacks"] = v.sharded_fallbacks
+    failures: List[str] = []
+    if res["verdicts_lost"]:
+        failures.append(f"{res['verdicts_lost']} stranded futures")
+    if res["errors"]:
+        failures.append(f"untyped errors: {res['errors'][:3]}")
+    false_verdicts = (
+        baseline["outcomes"]["false"] + under_fault["outcomes"]["false"]
+        + heal_stats["false"] + recovered["outcomes"]["false"]
+    )
+    if false_verdicts:
+        failures.append("a lost mesh produced a False verdict")
+    if not mesh_dispatches:
+        failures.append("no sharded bls.dispatch — the mesh tier never engaged")
+    if not any(e.get("mesh_devices", 0) >= 2 for e in mesh_dispatches):
+        failures.append("sharded dispatch events missing mesh_devices >= 2")
+    if not requeues:
+        failures.append(
+            "no bls.requeue from the mesh — the failed sharded batch "
+            "was not replayed on a survivor"
+        )
+    if quarantine is None:
+        failures.append(f"{target} was never quarantined")
+    if readmit is None or not healed:
+        failures.append(f"{target} was never re-admitted")
+    if v.sharded is not True:
+        failures.append(
+            "sharded tier sticky-disabled by a SYNC fault — sync faults "
+            "must ride the health machine, not the tier kill-switch"
+        )
+
+    # the mesh dump must pass the pipeline gate INCLUDING the new mesh
+    # rules (mesh_devices present, devices_total honest)
+    trace_path = os.path.join(out_dir, "sharded_loss_trace.json")
+    tracing.write_chrome_trace(tracing.TRACER, trace_path)
+    if check_trace.main([trace_path, "--require-pipeline", "2"]) != 0:
+        failures.append("mesh trace failed --require-pipeline")
     res["trace"] = trace_path
 
     if failures:
@@ -843,6 +964,7 @@ def scenario_forensics_io(seed: int, out_dir: str, inspect_bundle,
 
 SCENARIOS = (
     scenario_device_loss,
+    scenario_sharded_loss,
     scenario_device_wedge,
     scenario_compile_ladder,
     scenario_cache_corrupt,

@@ -25,7 +25,12 @@ correlated (same ``args.cid``) for at least N distinct merged batches
 (any ``bls.dispatch`` span carries ``args.devices_total > 1``) it also
 asserts the dispatches landed on >= 2 distinct ``args.device`` ids — a
 pool that funnels every batch to one chip is a scheduler bug, not a
-pipeline.  ``bls.shed`` spans (overload policy) exclude their cid from
+pipeline.  Mesh dispatch (the sharded tier, docs/multichip.md): a
+dispatch span carrying ``args.sharded`` must also carry
+``args.mesh_devices >= 2`` and a ``devices_total > 1`` — a "sharded"
+batch that reports one device never left a single chip; conversely one
+sharded span with ``mesh_devices >= 2`` satisfies the distinct-device
+requirement by itself (the mesh program spans every chip).  ``bls.shed`` spans (overload policy) exclude their cid from
 the pipeline requirement; ``bls.requeue`` spans (self-healing pool,
 docs/chaos.md) do NOT — a requeued cid must still complete its pipeline
 via the replay, and must show >= 2 ``bls.dispatch`` attempts.  This is
@@ -114,6 +119,8 @@ def validate_pipeline(trace: Any, min_batches: int = 2) -> List[str]:
     dispatches_by_cid: Dict[Any, int] = {}
     devices_seen = set()
     devices_total = 1
+    mesh_covered = False  # a sharded span with mesh_devices >= 2 seen
+    mesh_errors: List[str] = []
     for ev in events:
         if not isinstance(ev, dict) or ev.get("ph") != "X":
             continue
@@ -133,7 +140,27 @@ def validate_pipeline(trace: Any, min_batches: int = 2) -> List[str]:
         args = ev.get("args") or {}
         if name == "bls.dispatch":
             devices_total = max(devices_total, int(args.get("devices_total", 1)))
-            if args.get("device") is not None:
+            if args.get("sharded"):
+                # mesh dispatch contract: the span must say how many
+                # chips the batch actually spanned, and a sharded batch
+                # on a 1-device "mesh" is the scheduler lying
+                mesh_n = args.get("mesh_devices")
+                if not isinstance(mesh_n, int) or mesh_n < 2:
+                    mesh_errors.append(
+                        f"pipeline: sharded bls.dispatch span (cid "
+                        f"{args.get('cid')}) must carry integer "
+                        f"args.mesh_devices >= 2, got {mesh_n!r}"
+                    )
+                elif int(args.get("devices_total", 1)) <= 1:
+                    mesh_errors.append(
+                        f"pipeline: sharded bls.dispatch span (cid "
+                        f"{args.get('cid')}) reports devices_total == 1 — "
+                        f"a mesh-spanning batch on a single-device pool "
+                        f"is not sharded"
+                    )
+                else:
+                    mesh_covered = True
+            elif args.get("device") is not None:
                 devices_seen.add(args["device"])
         cid = args.get("cid", ev.get("id"))
         if cid is None:
@@ -162,11 +189,13 @@ def validate_pipeline(trace: Any, min_batches: int = 2) -> List[str]:
             f"({len(shed_cids)} shed batches excluded; "
             f"partial batches: {partial})"
         )
-    if devices_total > 1 and len(devices_seen) < 2:
+    errors.extend(mesh_errors)
+    # one valid mesh-spanning dispatch covers every chip by construction
+    if devices_total > 1 and len(devices_seen) < 2 and not mesh_covered:
         errors.append(
             f"pipeline: multi-device dump (devices_total={devices_total}) but "
             f"dispatches landed on {sorted(devices_seen)} — expected >= 2 "
-            f"distinct device ids"
+            f"distinct device ids (or a sharded mesh dispatch)"
         )
     # a requeued batch (bls.requeue) must show its replay: >= 2 dispatch
     # attempts under the same cid, else the recovery path lost the batch

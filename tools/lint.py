@@ -35,6 +35,15 @@ _REPO_DEFAULT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO_DEFAULT)
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")  # tracing never needs a TPU
+# the sharded-entry audit needs >= 2 devices at trace time (shard_map
+# binds mesh devices); force the tier-1 virtual-device shape so a
+# standalone lint builds the SAME artifacts the suite replays
+if "jax" not in sys.modules:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 from lodestar_tpu.analysis import format_report, run_all  # noqa: E402,F401
 from lodestar_tpu.analysis.report import to_dicts  # noqa: E402

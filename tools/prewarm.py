@@ -26,8 +26,13 @@ writers (exit 1 on any corrupt entry, after listing them).
 
 Usage:
     python tools/prewarm.py --store .aot_store --buckets 4,16 --devices 0
+    python tools/prewarm.py --store .aot_store --buckets 128 --devices 0 --mesh
     python tools/prewarm.py --store .aot_store --verify
     python tools/prewarm.py --store .aot_store --verify --sweep-orphans
+
+``--mesh`` builds the round-11 sharded tier's whole-mesh program (ONE
+``mesh{k}``-keyed entry per bucket, shared by every restart of the node
+that runs that mesh) instead of the per-ordinal fan-out.
 """
 
 from __future__ import annotations
@@ -62,10 +67,17 @@ FARM_LOCK_NAME = "prewarm.lock"
 
 def prewarm(store_path: str, buckets, n_devices: int = 1,
             fused: Optional[bool] = None, host_final_exp: bool = True,
-            lock_wait_s: float = 2.0) -> Dict[str, Any]:
+            lock_wait_s: float = 2.0, mesh: bool = False) -> Dict[str, Any]:
     """Populate ``store_path`` for this host's topology.  Returns the
     report dict; ``{"locked": True}`` when another prewarmer holds the
-    farm lock (the caller exits 3 — never a stampede)."""
+    farm lock (the caller exits 3 — never a stampede).
+
+    ``mesh=True`` is the round-11 sharded-tier mode: instead of the
+    per-ordinal fan-out, it builds the ONE mesh-spanning shard_map
+    program per eligible bucket (``warmup_sharded``), stored and
+    ledgered under the single ``mesh{k}`` key — the whole fleet's mesh
+    program compiles here exactly once, never once per ordinal and
+    never once per restart."""
     from lodestar_tpu.aot.store import (
         AotExecutableStore,
         acquire_lockfile,
@@ -103,17 +115,52 @@ def prewarm(store_path: str, buckets, n_devices: int = 1,
         devices = None if n_devices == 1 else (
             local if n_devices == 0 else local[:n_devices]
         )
-        v = TpuBlsVerifier(
-            buckets=tuple(buckets), devices=devices,
-            fused=fused, host_final_exp=host_final_exp, aot_store=store,
-        )
-        wall = v.warmup()
+        if mesh:
+            if devices is None or len(devices) < 2:
+                raise SystemExit(
+                    "--mesh needs a multi-device pool: pass --devices N "
+                    "(>= 2) or 0 (all local devices)"
+                )
+            eligible = [b for b in buckets if b % len(devices) == 0]
+            if not eligible:
+                # a silent zero-program "success" would let the operator
+                # believe the fleet mesh program is stored when nothing is
+                raise SystemExit(
+                    f"--mesh: none of buckets {sorted(buckets)} divide "
+                    f"evenly across {len(devices)} devices — nothing to "
+                    f"prewarm"
+                )
+            # the mesh program takes any eligible bucket — for a prewarm
+            # the requested buckets ARE the eligible set (min = smallest)
+            v = TpuBlsVerifier(
+                buckets=tuple(buckets), devices=devices,
+                fused=fused, host_final_exp=host_final_exp, aot_store=store,
+                sharded=True, sharded_min_batch=min(buckets),
+            )
+            wall = v.warmup_sharded()
+            if v.sharded_fallbacks:
+                raise SystemExit(
+                    f"--mesh: warmup degraded after "
+                    f"{len(v._mesh_ex.compiled)} of {len(eligible)} mesh "
+                    f"program(s) — the store is NOT fully populated"
+                )
+        else:
+            v = TpuBlsVerifier(
+                buckets=tuple(buckets), devices=devices,
+                fused=fused, host_final_exp=host_final_exp, aot_store=store,
+            )
+            wall = v.warmup()
         return {
             "store": store_path,
             "topology": topology_tag(),
             "buckets": list(buckets),
-            "devices": [ex.name for ex in v._executors],
+            "devices": (
+                [v._mesh_ex.name] if mesh
+                else [ex.name for ex in v._executors]
+            ),
+            "mesh": mesh or None,
             "fused": v.fused,
+            "sharded_fallbacks": v.sharded_fallbacks if mesh else None,
             "warmup_s": round(wall, 2),
             "wall_s": round(time.perf_counter() - t0, 2),
             "stats": store.stats(),
@@ -147,6 +194,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="device ordinals to fan out over: 1 = first "
                     "(default), N = first N, 0 = every local device")
     ap.add_argument("--fused", choices=("auto", "on", "off"), default="auto")
+    ap.add_argument("--mesh", action="store_true",
+                    help="build the ONE mesh-spanning sharded program per "
+                    "bucket (stored under the mesh{k} key) instead of the "
+                    "per-ordinal fan-out; requires --devices >= 2 or 0")
     ap.add_argument("--host-final-exp", choices=("on", "off"), default="on")
     ap.add_argument("--lock-wait-s", type=float, default=2.0,
                     help="bounded wait for the farm lock before exiting 3")
@@ -180,7 +231,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     report = prewarm(
         store_path, buckets, n_devices=args.devices, fused=fused,
         host_final_exp=args.host_final_exp == "on",
-        lock_wait_s=args.lock_wait_s,
+        lock_wait_s=args.lock_wait_s, mesh=args.mesh,
     )
     if report.get("locked"):
         print(
