@@ -61,8 +61,9 @@ def build_batch(n: int):
 
 def bench_lint():
     """Pre-flight invariant lint (tools/lint.py run_all): AST rules, the
-    lock/race audit, and the jaxpr IR audit of every fused entry point at
-    the production bucket pair.
+    lock/race audit, the compile-cost audit of the test suite, and the
+    jaxpr IR audit (including the limb-interval overflow proofs) of every
+    fused entry point at the production bucket pair.
 
     Returns the violation dicts.  The gate RECORDS them in extras.lint
     instead of silently proceeding — a Mosaic-unsafe splice or an

@@ -12,12 +12,14 @@ no existing check could see before runtime:
 - Shared mutable state (verifier counters, the ``PointCache`` LRU)
   mutated from ``asyncio.to_thread`` workers introduced in PR 3.
 
-Three layers, one report format (``report.Violation``):
+Four layers, one report format (``report.Violation``):
 
 - ``jaxpr_audit``  — abstract-traces every public fused program in
   ``lodestar_tpu/ops/`` (``jax.make_jaxpr`` only: no backend compile, no
   device programs, so it runs inside the tier-1 conftest compile guard)
-  and asserts TPU-portability invariants on the IR.
+  and asserts TPU-portability invariants on the IR.  Includes
+  ``limb_interval``: interval analysis proving the limb arithmetic's
+  digit magnitudes stay inside the f32 exactly-representable range.
 - ``ast_lint``     — pluggable AST checkers encoding the project's
   async/tracing/locking discipline over the whole ``lodestar_tpu/`` tree.
 - ``lock_audit``   — instrumented lock wrappers + a deterministic
@@ -25,11 +27,18 @@ Three layers, one report format (``report.Violation``):
   (``BlsBatchPool._flush`` → ``TpuBlsVerifier.dispatch`` →
   ``DeviceExecutor``) that flags unguarded shared-state mutation and
   lock-order inversions at the first offending call, not by racing.
+- ``compile_cost`` — stdlib-only AST + import-graph auditor proving
+  which tier-1 tests materialize device programs, cross-checked against
+  the runtime ledgers and the conftest compile-guard whitelist (tier-1
+  died rc=124 three times in one session with ZERO failing tests; the
+  compile budget is now a statically checked property).
 
-``tools/lint.py`` drives all three and exits nonzero on violations;
-``bench.py`` runs the same suite as a pre-flight stage.  The rule
-catalogue (with the incident behind each rule and the inline-suppression
-syntax) is docs/static_analysis.md.
+``tools/lint.py`` drives all four and exits nonzero on violations;
+``bench.py`` runs the same suite as a pre-flight stage;
+``tools/tier1_budget.py --enforce`` combines the compile-cost layer with
+the wall-clock margin gate.  The rule catalogue (with the incident
+behind each rule and the inline-suppression syntax) is
+docs/static_analysis.md.
 """
 
 from typing import List, Sequence
@@ -43,6 +52,7 @@ def run_all(
     with_jaxpr: bool = True,
     with_lock_audit: bool = True,
     trace_cache: bool = True,
+    with_compile_cost: bool = True,
 ) -> List[Violation]:
     """Every analysis layer, one violation list — the entry point
     tools/lint.py, bench.py's pre-flight stage, and the tier-1 tests share
@@ -56,6 +66,10 @@ def run_all(
     from .ast_lint import run_ast_lint
 
     violations = list(run_ast_lint(repo))
+    if with_compile_cost:
+        from .compile_cost import audit_compile_cost
+
+        violations += audit_compile_cost(repo=repo)
     if with_lock_audit:
         from .lock_audit import audit_bls_pipeline
 
@@ -64,4 +78,7 @@ def run_all(
         from .jaxpr_audit import audit_all
 
         violations += audit_all(buckets=tuple(buckets), use_cache=trace_cache)
+        from .limb_interval import audit_limb_overflow
+
+        violations += audit_limb_overflow(repo=repo)
     return violations

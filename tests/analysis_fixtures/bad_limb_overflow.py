@@ -1,0 +1,84 @@
+"""Known-bad fixture for ``jaxpr-limb-overflow`` (interval analysis).
+
+IMPORTABLE like ``bad_jaxpr_programs``: tests trace these through
+``limb_interval.analyze_callable`` (make_jaxpr only — no backend
+compile) and assert the rule fires EXACTLY on the marked lines via the
+jaxpr's per-eqn source info.
+
+Each bad program respects the limb format's shapes but breaks a digit-
+magnitude contract: the arithmetic stays silently *wrong* (f32 rounds
+past 2^24), never raises — exactly the class of bug the fused pairing
+kernels could only hit at batch scale on hardware.
+
+``BAD_PROGRAMS`` / ``GOOD_PROGRAMS``: (fn, in_shapes, in_intervals).
+"""
+
+import jax.numpy as jnp
+
+NLIMBS = 50
+STRICT = (0.0, 256.0)  # semi-strict digit contract (carry fixed point)
+
+
+def scaled_product_no_finalize(a, b):
+    """Digit products of two strict elements are < 2^16 and exact — but
+    re-scaling the product row by another full digit (a fused "shortcut"
+    that skips the carry ladder) lands at 2^16 * 2^16 = 2^32, far past
+    the 2^24 f32-exact ceiling: low bits are silently rounded away."""
+    row = a * b  # fine: 256 * 256 = 2^16, exact
+    scaled = row * 65025.0  # VIOLATION: 2^16 * 255^2 > 2^24, rounds
+    return scaled * 0.0 + row
+
+
+def lazy_add_ladder(x):
+    """fp_add is deliberately lazy (digitwise sum, NO carry); chains must
+    re-normalize before digits cross 2^24.  Doubling a strict element 17
+    times without a single carry_exact crosses the ceiling."""
+    acc = x
+    for _ in range(17):
+        acc = acc + acc  # VIOLATION: 2^8 << 17 = 2^25 > 2^24
+    return acc
+
+
+def anti_diagonal_over_accumulation(a, b):
+    """The schoolbook multiply keeps anti-diagonal partial sums < 2^22 by
+    folding every 50 rows; accumulating 50 rows of UN-shifted full-width
+    products (a broken splice that drops the pad) concentrates all 50
+    products (< 2^16 each) onto the same digits: 50 * 2^16 > 2^21 is
+    still fine — so square the row first to model the digit-squared
+    variant a transposed operand produces: 50 * 2^32 overflows."""
+    z = jnp.zeros((NLIMBS,), dtype=jnp.float32)
+    for i in range(NLIMBS):
+        row = a * b
+        z = z + row * row  # VIOLATION: sum of 50 digit-squared products
+    return z
+
+
+def carried_mac_chain(a, b):
+    """GOOD: the same accumulation with the bound respected — products
+    stay < 2^16 and the 50-term sum < 50 * 2^16 < 2^22, all exact."""
+    z = jnp.zeros((NLIMBS,), dtype=jnp.float32)
+    for _ in range(NLIMBS):
+        z = z + a * b
+    return z
+
+
+def split_mod_idiom(d):
+    """GOOD: the limbs._split carry idiom — interval analysis must
+    recognize d - floor(d * 2^-8) * 2^8 as d mod 256 (naive interval
+    subtraction would blow up the carry chain instead)."""
+    hi = jnp.floor(d * (1.0 / 256.0))
+    lo = d - hi * 256.0
+    return lo + hi * 0.0
+
+
+BAD_PROGRAMS = [
+    (scaled_product_no_finalize, [(NLIMBS,), (NLIMBS,)], [STRICT, STRICT]),
+    (lazy_add_ladder, [(NLIMBS,)], [STRICT]),
+    (anti_diagonal_over_accumulation, [(NLIMBS,), (NLIMBS,)],
+     [STRICT, STRICT]),
+]
+
+GOOD_PROGRAMS = [
+    (carried_mac_chain, [(NLIMBS,), (NLIMBS,)], [STRICT, STRICT]),
+    (split_mod_idiom, [(NLIMBS,)], [(0.0, float((1 << 24) - 1))]),
+]

@@ -1,10 +1,17 @@
 """Differential tests for the fused G2 ladder-iteration kernels
 (ops/fused_ladder.py) against the composed path (fused_points) and the
 bigint oracle — interpret mode (CPU), small shapes.
+
+Slow-marked by the PR 15 compile-cost audit: the three ladder programs
+re-lower every run (~140 s of tier-1 wall, 8 compile-guard events in the
+run ledger) and the fused path's tier-1 pin is test_fused_verify_alignment;
+ladder ground truth runs in the nightly ``-m slow`` tier.
 """
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow
 
 import jax.numpy as jnp
 

@@ -583,14 +583,61 @@ class TestTier1Budget:
         assert budget_main(["--repo", repo, "--fail-margin", "20"]) == 0
 
     def test_partial_run_never_gates(self, tmp_path):
+        """A `-k` subset (schema-1 legacy ledger) lands in the partial
+        ring on read: the margin comes from the latest FULL run even
+        when a subset ran after it, so a slow 12-test subset can
+        neither trip --fail-margin nor dilute the movers baseline."""
         from tools.tier1_budget import analyze, main as budget_main
 
         repo = self._ledger(tmp_path, [
-            {"wall_s": 800.0, "n_tests": 550, "exitstatus": 0, "tests": {}},
-            {"wall_s": 860.0, "n_tests": 12, "exitstatus": 0, "tests": {}},
+            {"wall_s": 800.0, "n_tests": 550, "exitstatus": 0,
+             "utc": 100.0, "tests": {}},
+            {"wall_s": 860.0, "n_tests": 12, "exitstatus": 0,
+             "utc": 200.0, "tests": {}},
         ])
-        assert analyze(repo)["is_full_run"] is False
+        report = analyze(repo)
+        assert report["is_full_run"] is True  # gating entry IS the full run
+        assert report["margin_s"] == 70.0  # 870 - 800, never 870 - 860
+        assert report["newer_partial"] is True
+        assert [r["n_tests"] for r in report["partial_runs"]] == [12]
         assert budget_main(["--repo", repo, "--fail-margin", "35"]) == 0
+
+    def test_partial_ring_cannot_evict_full_baselines(self, tmp_path):
+        """The PR 15 bugfix proper: schema-2 rings mean eight -k runs
+        after one full run still leave the full run as the movers/margin
+        baseline instead of aging it out of a shared last-8 window."""
+        from tools.tier1_budget import analyze, load_ledger
+
+        full = {"wall_s": 500.0, "n_tests": 550, "exitstatus": 0,
+                "utc": 1.0, "tests": {"tests/test_x.py::t": 9.0}}
+        subsets = [
+            {"wall_s": 30.0 + i, "n_tests": 10, "exitstatus": 0,
+             "utc": 2.0 + i, "tests": {}}
+            for i in range(8)
+        ]
+        repo = self._ledger(tmp_path, [full] + subsets)
+        rings = load_ledger(repo)
+        assert [r["n_tests"] for r in rings["full"]] == [550]
+        assert len(rings["partial"]) == 8
+        report = analyze(repo)
+        assert report["margin_s"] == 370.0
+        assert report["slowest"][0]["test"] == "tests/test_x.py::t"
+
+    def test_schema2_ledger_roundtrip(self, tmp_path):
+        """tier1_budget reads the schema-2 layout conftest now writes."""
+        from tools.tier1_budget import load_ledger
+
+        cache = tmp_path / ".jax_cache"
+        cache.mkdir()
+        with open(cache / "tier1_timings.json", "w") as f:
+            json.dump({"schema": 2,
+                       "runs": [{"wall_s": 500.0, "n_tests": 550,
+                                 "exitstatus": 0, "tests": {}}],
+                       "partial_runs": [{"wall_s": 12.0, "n_tests": 3,
+                                         "exitstatus": 0, "tests": {}}]}, f)
+        rings = load_ledger(str(tmp_path))
+        assert [r["n_tests"] for r in rings["full"]] == [550]
+        assert [r["n_tests"] for r in rings["partial"]] == [3]
 
     def test_empty_ledger(self, tmp_path):
         from tools.tier1_budget import analyze
@@ -606,6 +653,30 @@ class TestTier1Budget:
         assert cft._TIER1_LEDGER.endswith("tier1_timings.json")
         # the in-memory collectors exist and carry this session's tests
         assert isinstance(cft._test_durations, dict)
+
+    def test_conftest_writer_splits_rings(self, tmp_path, monkeypatch):
+        """_write_tier1_ledger routes a -k subset into partial_runs and a
+        full session into runs — the two rings never displace each
+        other (satellite: -k runs used to evict full-run baselines)."""
+        import tests.conftest as cft
+
+        ledger = tmp_path / ".jax_cache" / "tier1_timings.json"
+        monkeypatch.setattr(cft, "_TIER1_LEDGER", str(ledger))
+        monkeypatch.setattr(cft, "_compile_log", [])
+        monkeypatch.setattr(cft, "_test_compiles", {})
+        monkeypatch.setattr(
+            cft, "_test_durations", {f"a::t{i}": 1.0 for i in range(3)})
+        cft._write_tier1_ledger(0)
+        data = json.load(open(ledger))
+        assert data["schema"] == 2
+        assert data["runs"] == []
+        assert [r["n_tests"] for r in data["partial_runs"]] == [3]
+        monkeypatch.setattr(
+            cft, "_test_durations", {f"a::t{i}": 0.5 for i in range(450)})
+        cft._write_tier1_ledger(0)
+        data = json.load(open(ledger))
+        assert [r["n_tests"] for r in data["runs"]] == [450]
+        assert [r["n_tests"] for r in data["partial_runs"]] == [3]
 
 
 # ---------------------------------------------------------------------------

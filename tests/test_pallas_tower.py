@@ -79,7 +79,11 @@ def test_semi_strict_edge_digits():
     assert _to_fq2(out2[0]) == want * want
 
 
+@pytest.mark.slow
 def test_fq6_mul_matches_oracle():
+    # slow-marked by the PR 15 compile-cost audit: the interpret-mode tower
+    # multiply re-lowers every run (~14 s tier-1 wall); pallas coverage
+    # stays pinned tier-1 by the fq2 tests and test_pallas_fuse.py
     rng = np.random.default_rng(41)
 
     def rand_fq6():
@@ -111,7 +115,9 @@ def test_fq6_mul_matches_oracle():
         assert tower.fq6_to_oracle(lib[i]) == avals[i] * bvals[i], i
 
 
+@pytest.mark.slow
 def test_fq12_mul_matches_oracle():
+    # slow-marked with test_fq6_mul_matches_oracle (same audit; ~23 s)
     rng = np.random.default_rng(47)
 
     def rand_fq12():

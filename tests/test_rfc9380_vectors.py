@@ -131,7 +131,13 @@ class TestHashToG2Oracle:
         assert pt[1].c1 == int(y[1], 16)
 
 
+@pytest.mark.slow
 class TestHashToG2Device:
+    """Slow tier (PR 15 compile-cost restructure): the standalone
+    hash_to_g2_device jit is its own XLA program — test_ops_htc.py pins
+    the same device SSWU/iso/cofactor path in tier-1 on programs it
+    already owns, so the J.10-vector refinement runs nightly."""
+
     def test_j10_vectors_device(self):
         """Field draws on the host (RFC hash_to_field), SSWU+iso+cofactor on
         device — the exact split the TpuBlsVerifier uses."""

@@ -4,6 +4,19 @@ device kernel, plus cross-verifier differential checks.
 
 Runs on the CPU backend (conftest pins JAX_PLATFORMS=cpu); the kernel code
 is backend-agnostic.
+
+Split by the PR 15 compile-cost audit (docs/static_analysis.md,
+"tier-1 budget discipline"): the real-kernel matrix materializes
+xla_split@{4,8} — two ~2.4 MB Miller-product programs whose XLA compile
+costs ~900 s on the CPU backend and whose persistent-cache key is not
+stable across process contexts, so every fresh tier-1 run risks paying
+it cold.  The matrix therefore runs in the nightly ``-m slow`` tier
+(where the compile budget is not capped), and tier-1 keeps the entire
+host-side surface — pack rejection, bucket selection, chunking, async
+lifecycle, metrics, stage accounting — on a verifier whose device
+programs are host stubs.  Everything except the XLA executable is real;
+the executable itself is pinned nightly here and by
+test_dev_chain_tpu.py's slow chain run.
 """
 
 import random
@@ -29,7 +42,23 @@ MSG = b"\x42" * 32
 
 @pytest.fixture(scope="module")
 def verifier():
+    """Real compiled kernels — slow-tier classes only."""
     v = TpuBlsVerifier(buckets=(4, 8))
+    yield v
+    v.close()
+
+
+@pytest.fixture(scope="module")
+def stub_verifier():
+    """Tier-1 host-path verifier: real pack / bucket selection / chunking /
+    executor dispatch, device programs replaced by host stubs so no XLA
+    program materializes (the compile-cost auditor proves this statically;
+    the compile guard enforces it at runtime — this fixture is deliberately
+    NOT in COMPILE_WHITELIST)."""
+    v = TpuBlsVerifier(buckets=(4, 8), fused=False, host_final_exp=False)
+    for ex in v._executors:
+        for b in (4, 8):
+            ex.compiled[(b, False, False)] = lambda *a: True
     yield v
     v.close()
 
@@ -49,7 +78,85 @@ def make_sets(n, start=0):
     return out
 
 
+class TestHostPath:
+    """Tier-1: the full host surface around the device boundary, zero
+    compiles.  Verdict-bearing device semantics (invalid detection, RLC,
+    padding masks) live in the slow matrix below."""
+
+    def test_valid_sets_verdict_plumbing(self, stub_verifier):
+        assert stub_verifier.verify_signature_sets(make_sets(3))
+
+    def test_empty_batch_raises(self, stub_verifier):
+        # reference parity: multithread/index.ts throws on an empty job; a
+        # silent False verdict would read as "invalid signature" upstream
+        with pytest.raises(ValueError):
+            stub_verifier.verify_signature_sets([])
+        with pytest.raises(ValueError):
+            stub_verifier.verify_signature_sets_async([])
+
+    def test_malformed_signature_bytes_rejected_not_raised(self, stub_verifier):
+        sets = make_sets(3)
+        sets[0].signature = b"\x00" * 96
+        assert not stub_verifier.verify_signature_sets(sets)
+
+    def test_infinity_pubkey_rejected(self, stub_verifier):
+        # pack-stage reject: never reaches a device program
+        from lodestar_tpu.crypto.bls.api import PublicKey
+        from lodestar_tpu.crypto.bls import curve as C
+
+        sets = make_sets(1)
+        s = AggregatedSignatureSet(
+            pubkeys=[PublicKey(C.Point.infinity(C.B1))],
+            signing_root=sets[0].signing_root,
+            signature=sets[0].signature,
+        )
+        assert not stub_verifier.verify_signature_sets([s])
+
+    def test_oversized_batch_chunks(self, stub_verifier):
+        # > largest bucket (8): exercises the chunkify path
+        before = stub_verifier.dispatches
+        assert stub_verifier.verify_signature_sets(make_sets(10))
+        assert stub_verifier.dispatches == before + 2
+
+    def test_metrics_counters(self, stub_verifier):
+        before = stub_verifier.dispatches
+        stub_verifier.verify_signature_sets(make_sets(2))
+        assert stub_verifier.dispatches == before + 1
+        assert stub_verifier.sets_verified >= 2
+
+    def test_async_returns_pending_then_verdict(self, stub_verifier):
+        pending = stub_verifier.verify_signature_sets_async(make_sets(2))
+        assert not pending.done_hint()
+        assert pending.result() is True
+        assert pending.done_hint()
+        assert pending.result() is True  # idempotent
+
+    def test_async_malformed_short_circuits_without_dispatch(self, stub_verifier):
+        sets = make_sets(1)
+        sets[0].signature = b"\xff" * 96
+        before = stub_verifier.dispatches
+        pending = stub_verifier.verify_signature_sets_async(sets)
+        assert pending.done_hint() and pending.result() is False
+        assert stub_verifier.dispatches == before  # pack rejected, nothing enqueued
+
+    def test_async_oversized_batch_chunks_back_to_back(self, stub_verifier):
+        before = stub_verifier.dispatches
+        pending = stub_verifier.verify_signature_sets_async(make_sets(10))
+        # both chunks enqueued before any sync
+        assert stub_verifier.dispatches == before + 2
+        assert pending.result() is True
+
+    def test_stage_seconds_accumulate(self, stub_verifier):
+        pack0 = stub_verifier.stage_seconds["pack"]
+        assert stub_verifier.verify_signature_sets(make_sets(2))
+        assert stub_verifier.stage_seconds["pack"] > pack0
+
+
+@pytest.mark.slow
 class TestTpuVerifierMatrix:
+    """Nightly: verdict semantics through REAL compiled kernels
+    (xla_split@{4,8} — the single biggest compile in the repo)."""
+
     def test_valid_sets(self, verifier):
         assert verifier.verify_signature_sets(make_sets(3))
 
@@ -76,19 +183,6 @@ class TestTpuVerifierMatrix:
         )
         assert verifier.verify_signature_sets([s])
 
-    def test_malformed_signature_bytes_rejected_not_raised(self, verifier):
-        sets = make_sets(3)
-        sets[0].signature = b"\x00" * 96
-        assert not verifier.verify_signature_sets(sets)
-
-    def test_empty_batch_raises(self, verifier):
-        # reference parity: multithread/index.ts throws on an empty job; a
-        # silent False verdict would read as "invalid signature" upstream
-        with pytest.raises(ValueError):
-            verifier.verify_signature_sets([])
-        with pytest.raises(ValueError):
-            verifier.verify_signature_sets_async([])
-
     def test_padding_lanes_do_not_leak(self, verifier):
         # bucket 4 with 2 live sets: padding copies lane 0; a bad lane 0
         # must fail even though its copies are masked
@@ -97,7 +191,7 @@ class TestTpuVerifierMatrix:
         assert not verifier.verify_signature_sets(sets)
 
     def test_oversized_batch_chunks(self, verifier):
-        # > largest bucket (8): exercises the chunkify path
+        # > largest bucket (8): chunkify with a real verdict per chunk
         sets = make_sets(10)
         assert verifier.verify_signature_sets(sets)
         sets[9].signing_root = b"\x01" * 32
@@ -112,58 +206,20 @@ class TestTpuVerifierMatrix:
                 sets[k].signature = interop_secret_key(50 + trial).sign(sets[k].signing_root).to_bytes()
             assert verifier.verify_signature_sets(sets) == py.verify_signature_sets(sets)
 
-    def test_metrics_counters(self, verifier):
-        before = verifier.dispatches
-        verifier.verify_signature_sets(make_sets(2))
-        assert verifier.dispatches == before + 1
-        assert verifier.sets_verified >= 2
-
-
-class TestPipelineApi:
-    """The round-6 stage-split surface: pack / dispatch / PendingVerdict."""
-
-    def test_async_returns_pending_then_verdict(self, verifier):
-        pending = verifier.verify_signature_sets_async(make_sets(2))
-        assert not pending.done_hint()
-        assert pending.result() is True
-        assert pending.done_hint()
-        assert pending.result() is True  # idempotent
-
-    def test_async_malformed_short_circuits_without_dispatch(self, verifier):
-        sets = make_sets(1)
-        sets[0].signature = b"\xff" * 96
-        before = verifier.dispatches
-        pending = verifier.verify_signature_sets_async(sets)
-        assert pending.done_hint() and pending.result() is False
-        assert verifier.dispatches == before  # pack rejected, nothing enqueued
-
-    def test_async_oversized_batch_chunks_back_to_back(self, verifier):
-        before = verifier.dispatches
-        pending = verifier.verify_signature_sets_async(make_sets(10))
-        # both chunks enqueued before any sync
-        assert verifier.dispatches == before + 2
-        assert pending.result() is True
-
-    def test_stage_seconds_accumulate(self, verifier):
+    def test_stage_seconds_accumulate_through_final_exp(self, verifier):
+        # the split path's host final-exp stage only runs on real dispatch
         pack0 = verifier.stage_seconds["pack"]
         fexp0 = verifier.stage_seconds["final_exp"]
         assert verifier.verify_signature_sets(make_sets(2))
         assert verifier.stage_seconds["pack"] > pack0
         assert verifier.stage_seconds["final_exp"] > fexp0
 
-    @pytest.mark.slow
-    def test_warmup_aot_compiles_bucket(self):
-        v = TpuBlsVerifier(buckets=(4,))
-        dt = v.warmup()
-        assert dt >= 0 and v.stage_seconds["warmup"] >= dt
-        # the AOT executable (not a jit wrapper) serves the dispatch
-        key = (4, v.host_final_exp, v.fused)
-        assert key in v._compiled and not hasattr(v._compiled[key], "lower")
-        assert v.verify_signature_sets(make_sets(2))
-        v.close()
 
-
+@pytest.mark.slow
 class TestAdversarial:
+    """Nightly: adversarial inputs whose verdict depends on the device
+    program (subgroup check, per-lane RLC)."""
+
     def test_non_subgroup_signature_rejected(self, verifier):
         # forge bytes for an on-curve, non-subgroup G2 point
         from lodestar_tpu.crypto.bls import curve as C
@@ -184,19 +240,20 @@ class TestAdversarial:
         sets[1].signature = C.g2_to_bytes(bad)
         assert not verifier.verify_signature_sets(sets)
 
-    def test_infinity_pubkey_rejected(self, verifier):
-        from lodestar_tpu.crypto.bls.api import PublicKey
-        from lodestar_tpu.crypto.bls import curve as C
-
-        sets = make_sets(1)
-        s = AggregatedSignatureSet(
-            pubkeys=[PublicKey(C.Point.infinity(C.B1))],
-            signing_root=sets[0].signing_root,
-            signature=sets[0].signature,
-        )
-        assert not verifier.verify_signature_sets([s])
-
     def test_duplicate_sets_ok(self, verifier):
         # identical sets in one batch (RLC coefficients differ per lane)
         s = make_sets(1)
         assert verifier.verify_signature_sets([s[0], s[0], s[0]])
+
+
+@pytest.mark.slow
+class TestWarmupAot:
+    def test_warmup_aot_compiles_bucket(self):
+        v = TpuBlsVerifier(buckets=(4,))
+        dt = v.warmup()
+        assert dt >= 0 and v.stage_seconds["warmup"] >= dt
+        # the AOT executable (not a jit wrapper) serves the dispatch
+        key = (4, v.host_final_exp, v.fused)
+        assert key in v._compiled and not hasattr(v._compiled[key], "lower")
+        assert v.verify_signature_sets(make_sets(2))
+        v.close()

@@ -6,17 +6,24 @@ Layers (see lodestar_tpu/analysis/ and docs/static_analysis.md):
 
 1. AST lint over lodestar_tpu/ (async hot-path discipline, tracing
    clock discipline, lock-hold discipline, metrics coverage).
-2. Lock/race audit: instrumented-lock interleaving harness over
+2. Compile-cost audit: stdlib AST + import graph over tests/ and tools/
+   proving which tier-1 tests materialize device programs, cross-checked
+   against .jax_cache/tier1_timings.json and the conftest compile-guard
+   whitelist (rules compile-unstubbed-test, compile-duplicate-program,
+   compile-whitelist-stale, tier2-unmarked).
+3. Lock/race audit: instrumented-lock interleaving harness over
    BlsBatchPool._flush -> TpuBlsVerifier.dispatch -> DeviceExecutor.
-3. Jaxpr auditor: abstract traces of every public fused entry point in
+4. Jaxpr auditor: abstract traces of every public fused entry point in
    lodestar_tpu/ops/ at two bucket sizes (make_jaxpr only — CPU-safe, no
    device programs; ~2 min cold, then incremental: per-entry artifacts
    are cached under .jax_cache/ keyed by a content hash of ops/, so
-   re-runs on an untouched ops/ replay in milliseconds).
+   re-runs on an untouched ops/ replay in milliseconds) plus the
+   limb-interval overflow proof over the ops/limbs.py contracts.
 
 Usage:
     python tools/lint.py [--repo PATH] [--json] [--skip-jaxpr]
-                         [--skip-lock-audit] [--buckets 4,128] [--rules]
+                         [--skip-lock-audit] [--skip-compile-cost]
+                         [--buckets 4,128] [--rules]
 
 Exit 0 when clean; exit 1 listing the violations.  tier-1 drives the same
 layers from tests/test_static_analysis.py; bench.py runs this as a
@@ -61,6 +68,11 @@ def _print_rules() -> None:
         ("jaxpr-f64-leak", "64-bit dtype outside the f32 limb format"),
         ("jaxpr-host-callback", "host callback inside a hot-path program"),
         ("jaxpr-unstable-cache-key", "captured scalar / bucket-dependent constants"),
+        ("jaxpr-limb-overflow", "limb digit magnitude proven past the f32-exact 2^24"),
+        ("compile-unstubbed-test", "tier-1 test reaches a real verifier materialization"),
+        ("compile-duplicate-program", "two tier-1 modules materialize the same program key"),
+        ("compile-whitelist-stale", "compile-guard whitelist entry covers no compiling test"),
+        ("tier2-unmarked", "compile-bound test missing the slow marker"),
     ]
     width = max(len(r) for r, _ in rows)
     for rule, desc in rows:
@@ -75,6 +87,8 @@ def main(argv: List[str] = None) -> int:
                     help="skip the (slow) jaxpr IR audit")
     ap.add_argument("--skip-lock-audit", action="store_true",
                     help="skip the lock/race interleaving harness")
+    ap.add_argument("--skip-compile-cost", action="store_true",
+                    help="skip the compile-cost static audit of tests/")
     ap.add_argument("--buckets", default="4,128",
                     help="comma-separated bucket sizes for the jaxpr audit")
     ap.add_argument("--no-trace-cache", action="store_true",
@@ -93,6 +107,7 @@ def main(argv: List[str] = None) -> int:
         with_jaxpr=not args.skip_jaxpr,
         with_lock_audit=not args.skip_lock_audit,
         trace_cache=not args.no_trace_cache,
+        with_compile_cost=not args.skip_compile_cost,
     )
     if args.json:
         print(json.dumps({"violations": to_dicts(violations)}, indent=2))

@@ -17,9 +17,15 @@ Usage:
     python tools/tier1_budget.py --json
     python tools/tier1_budget.py --fail-margin 35   # exit 1 when the
                                   # latest full run left < 35 s of cap
+    python tools/tier1_budget.py --enforce       # fail-margin 60 PLUS the
+                                  # compile-cost static audit: exit 1 on
+                                  # any violation or thin margin
 
-A run with far fewer tests than its predecessor (a `-k` subset) is
-reported but never gates — its wall time says nothing about the cap.
+Partial runs (`pytest -k` subsets, below
+run_ledger.TIER1_FULL_RUN_MIN_TESTS tests) live in their own ledger
+ring (``partial_runs``): they are reported but never gate, and the
+movers table always compares full-run against full-run — a `-k` subset
+can no longer push the real baselines out of the last-8 window.
 """
 
 from __future__ import annotations
@@ -40,13 +46,25 @@ from lodestar_tpu.observatory.run_ledger import (  # noqa: E402
 DEFAULT_CAP_S = 870.0
 
 
-def load_ledger(repo: str) -> List[Dict[str, Any]]:
+def load_ledger(repo: str) -> Dict[str, List[Dict[str, Any]]]:
+    """Both rings, as ``{"full": [...], "partial": [...]}``.
+
+    Schema 2 stores them separately; legacy schema-1 files (one mixed
+    ``runs`` list) are split on read by the same absolute threshold the
+    conftest writer uses, so old ledgers keep working."""
     path = os.path.join(repo, ".jax_cache", "tier1_timings.json")
     try:
         with open(path) as f:
-            return json.load(f).get("runs", [])
+            data = json.load(f)
     except (OSError, ValueError):
-        return []
+        return {"full": [], "partial": []}
+    runs = data.get("runs", [])
+    partial = data.get("partial_runs", [])
+    if data.get("schema", 1) < 2:
+        full = [r for r in runs if r.get("n_tests", 0) >= TIER1_FULL_RUN_MIN_TESTS]
+        partial = [r for r in runs if r.get("n_tests", 0) < TIER1_FULL_RUN_MIN_TESTS]
+        runs = full
+    return {"full": runs, "partial": partial}
 
 
 def movers(prev: Dict[str, float], last: Dict[str, float],
@@ -66,18 +84,21 @@ def movers(prev: Dict[str, float], last: Dict[str, float],
     return deltas[:top]
 
 
+def _run_summary(r: Dict[str, Any]) -> Dict[str, Any]:
+    return {"wall_s": r.get("wall_s"), "n_tests": r.get("n_tests"),
+            "exitstatus": r.get("exitstatus"),
+            "compile_events": r.get("compile_events"),
+            "compile_events_s": r.get("compile_events_s"),
+            "aot": r.get("aot")}
+
+
 def analyze(repo: str, cap_s: float = DEFAULT_CAP_S) -> Dict[str, Any]:
-    runs = load_ledger(repo)
+    rings = load_ledger(repo)
+    runs, partial = rings["full"], rings["partial"]
     out: Dict[str, Any] = {
         "cap_s": cap_s,
-        "runs": [
-            {"wall_s": r.get("wall_s"), "n_tests": r.get("n_tests"),
-             "exitstatus": r.get("exitstatus"),
-             "compile_events": r.get("compile_events"),
-             "compile_events_s": r.get("compile_events_s"),
-             "aot": r.get("aot")}
-            for r in runs
-        ],
+        "runs": [_run_summary(r) for r in runs],
+        "partial_runs": [_run_summary(r) for r in partial],
     }
     if not runs:
         return out
@@ -89,18 +110,22 @@ def analyze(repo: str, cap_s: float = DEFAULT_CAP_S) -> Dict[str, Any]:
     # "full" is absolute (run_ledger.TIER1_FULL_RUN_MIN_TESTS), never
     # relative to the previous entry: two identical `pytest -k` subsets
     # must not validate each other into gating the cap, and the very
-    # first ledger entry gets no benefit of the doubt either
+    # first ledger entry gets no benefit of the doubt either.  The
+    # gating entry always comes off the FULL ring, so a stack of `-k`
+    # subsets can never be the thing the margin is computed from.
     out["is_full_run"] = last.get("n_tests", 0) >= TIER1_FULL_RUN_MIN_TESTS
-    prev_full = None
-    for r in reversed(runs[:-1]):
-        if r.get("n_tests", 0) >= TIER1_FULL_RUN_MIN_TESTS:
-            prev_full = r
-            break
+    prev_full = runs[-2] if len(runs) >= 2 else None
     if prev_full is not None:
         out["movers"] = movers(prev_full.get("tests", {}), last.get("tests", {}))
         if last.get("wall_s") and prev_full.get("wall_s"):
             out["wall_delta_s"] = round(last["wall_s"] - prev_full["wall_s"], 1)
     out["aot"] = last.get("aot")
+    if partial:
+        p = partial[-1]
+        if p.get("utc") and last.get("utc") and p["utc"] > last["utc"]:
+            # the most recent chronological run was a -k subset: margin
+            # still reflects the older full run, flag the staleness
+            out["newer_partial"] = True
     slowest = sorted(
         last.get("tests", {}).items(), key=lambda kv: -kv[1]
     )[:10]
@@ -120,13 +145,24 @@ def render(report: Dict[str, Any]) -> str:
         f"{r['wall_s']}s({r['n_tests']}t,rc{r['exitstatus']})"
         for r in report["runs"]
     )
-    lines.append(f"  runs: {walls}")
+    lines.append(f"  full runs: {walls}")
+    if report.get("partial_runs"):
+        pwalls = " -> ".join(
+            f"{r['wall_s']}s({r['n_tests']}t,rc{r['exitstatus']})"
+            for r in report["partial_runs"]
+        )
+        lines.append(f"  partial (-k) runs [never gate]: {pwalls}")
     if report.get("margin_s") is not None:
-        flag = "  ⚠" if report["margin_s"] < 60 else ""
+        ok = report["margin_s"] >= 60
+        margin = f"margin {report['margin_s']}s"
+        if sys.stdout.isatty():
+            margin = f"\x1b[32m{margin}\x1b[0m" if ok else f"\x1b[31m{margin}\x1b[0m"
+        elif not ok:
+            margin += "  ⚠"
         lines.append(
-            f"  latest wall {report['last_wall_s']}s — margin "
-            f"{report['margin_s']}s{flag}"
-            + ("" if report.get("is_full_run") else "  [partial run: not gating]")
+            f"  latest full wall {report['last_wall_s']}s — {margin}"
+            + ("  [a newer -k subset ran since]" if report.get("newer_partial")
+               else "")
         )
     if report.get("wall_delta_s") is not None:
         lines.append(f"  wall delta vs previous full run: {report['wall_delta_s']:+}s")
@@ -164,8 +200,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--fail-margin", type=float, default=None, metavar="S",
                     help="exit 1 when the latest FULL run left less than "
                     "this many seconds of cap margin")
+    ap.add_argument("--enforce", action="store_true",
+                    help="CI gate: --fail-margin 60 combined with the "
+                    "compile-cost static audit — exit nonzero on any "
+                    "compile-cost violation OR a thin margin")
     args = ap.parse_args(argv)
+    if args.enforce and args.fail_margin is None:
+        args.fail_margin = 60.0
     report = analyze(args.repo, cap_s=args.cap)
+    rc = 0
+    if args.enforce:
+        from lodestar_tpu.analysis.compile_cost import audit_compile_cost
+        from lodestar_tpu.analysis.report import format_report, to_dicts
+
+        violations = audit_compile_cost(repo=args.repo)
+        report["compile_cost_violations"] = to_dicts(violations)
+        if violations:
+            print(format_report(violations), file=sys.stderr)
+            rc = 1
     print(json.dumps(report, indent=1) if args.json else render(report))
     if (
         args.fail_margin is not None
@@ -177,8 +229,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"tier-1 margin {report['margin_s']}s < {args.fail_margin}s",
             file=sys.stderr,
         )
-        return 1
-    return 0
+        rc = 1
+    return rc
 
 
 if __name__ == "__main__":
