@@ -230,6 +230,55 @@ def bench_cpu_oracle(n: int = 2):
     return n / best
 
 
+def bench_limb_mul(buckets=(4, 128), iters: int = 20):
+    """fp_mul microbench, ladder vs MXU (PR 18): ns per field multiply at
+    the gossip (4) and headline (128) bucket widths for each limb-mul
+    mode, plus the measured ladder->mxu ratio published as
+    ``fp_mul_speedup_mxu`` (run-ledger tripwired, direction +1).
+
+    Operands are tower-shaped ``(bucket, 54, 50)`` strict digit stacks so
+    the timed contraction is the batched MXU shape the pairing actually
+    runs (the 54-lane flat tower axis becomes the MXU batch dimension),
+    not a single-row toy.  Each mode is its own jit program (mode is a
+    static argname), warmed before timing.
+    """
+    import numpy as np
+
+    import jax
+
+    from lodestar_tpu.ops import limbs as fl
+
+    lanes = 54
+    rng = np.random.default_rng(0x18)
+    out = {"unit": "ns/fp_mul", "modes": {}}
+    ladder_ns = {}
+    mxu_ns = {}
+    for mode in ("ladder", "mxu"):
+        per_bucket = {}
+        for b in buckets:
+            a = rng.integers(0, 256, size=(b, lanes, fl.NLIMBS)).astype(np.float32)
+            c = rng.integers(0, 256, size=(b, lanes, fl.NLIMBS)).astype(np.float32)
+            aj = jax.numpy.asarray(a)
+            cj = jax.numpy.asarray(c)
+            fl.fp_mul(aj, cj, mode=mode).block_until_ready()  # compile
+            best = None
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                fl.fp_mul(aj, cj, mode=mode).block_until_ready()
+                dt = time.perf_counter() - t0
+                best = dt if best is None else min(best, dt)
+            ns = best / (b * lanes) * 1e9
+            per_bucket[str(b)] = round(ns, 1)
+            (ladder_ns if mode == "ladder" else mxu_ns)[b] = ns
+        out["modes"][mode] = per_bucket
+    head = max(buckets)
+    out["fp_mul_speedup_mxu"] = round(ladder_ns[head] / mxu_ns[head], 3)
+    out["fp_mul_speedup_mxu_small"] = round(
+        ladder_ns[min(buckets)] / mxu_ns[min(buckets)], 3
+    )
+    return out
+
+
 def bench_small_bucket(n: int = 16, budget_s: float = 120.0):
     """Dispatch latency for the small gossip bucket (VERDICT r3 weak 10:
     the latency distribution the node actually feels).  Soft-skipped when
@@ -1014,7 +1063,15 @@ def _stage(fn_name, args=(), timeout_s=600.0, retries=1):
     for attempt in range(retries + 1):
         ctx = multiprocessing.get_context("spawn")
         q = ctx.Queue()
-        p = ctx.Process(target=_stage_child, args=(q, fn_name, args), daemon=True)
+        # daemon=True is only a die-with-parent guarantee (timeouts are
+        # handled by the explicit terminate/kill below) — but a daemonic
+        # child may not have children of its own, and the cold_start
+        # stage measures fresh spawn grandchildren, so it alone runs
+        # non-daemonic
+        p = ctx.Process(
+            target=_stage_child, args=(q, fn_name, args),
+            daemon=(fn_name != "bench_cold_start"),
+        )
         p.start()
         try:
             status, payload = q.get(timeout=timeout_s)
@@ -1083,6 +1140,12 @@ def main() -> None:
     small_dt, err = _stage("bench_small_bucket", (), 300)
     if err:
         errors["bucket16"] = err
+    # PR-18 MXU limb multiply: ladder vs MXU fp_mul microbench — the
+    # per-multiply number under the headline, published with its own
+    # run-ledger tripwire (fp_mul_speedup_mxu)
+    limb_mul, err = _stage("bench_limb_mul", (), 420)
+    if err:
+        errors["limb_mul"] = err
     chain_res, err = _stage("bench_dev_chain", (), 420)
     if err:
         errors["dev_chain"] = err
@@ -1140,7 +1203,7 @@ def main() -> None:
     try:
         from lodestar_tpu.observatory import run_ledger
 
-        perf_deltas = run_ledger.deltas_vs_previous(_REPO, {
+        perf_deltas = run_ledger.deltas_vs_previous(_REPO, backend=jax.default_backend(), current={
             "bls_sig_sets_per_s_per_chip": dev_rate,
             "bls_sig_sets_per_s": (multichip or {}).get("bls_sig_sets_per_s"),
             "scaling_efficiency": (multichip or {}).get("scaling_efficiency"),
@@ -1160,6 +1223,7 @@ def main() -> None:
             "sustained_sets_per_s_at_slo": (firehose or {}).get(
                 "sustained_sets_per_s_at_slo"
             ),
+            "fp_mul_speedup_mxu": (limb_mul or {}).get("fp_mul_speedup_mxu"),
         })
     except Exception as e:  # noqa: BLE001 - the gate publishes regardless
         perf_deltas = {"error": str(e)}
@@ -1194,6 +1258,7 @@ def main() -> None:
                     "dev_chain_sampler_overhead_ratio": chain_res.get(
                         "sampler_overhead_ratio"
                     ),
+                    "limb_mul": limb_mul,
                     "multichip": multichip,
                     "scale_250k": scale,
                     "firehose": firehose,

@@ -27,6 +27,15 @@ Rules over the (recursively walked) equation graph:
   ``debug_callback`` (debug_print lowers to it) in a hot-path program:
   every callback is a device->host round trip serialized into the
   dispatch.
+- ``jaxpr-mxu-precision``        a ``dot_general`` anywhere in an audited
+  entry that does not carry the full MXU precision contract: an explicit
+  f32 ``preferred_element_type`` AND ``precision=HIGHEST`` on both
+  operands.  The limb representation's exactness proofs assume f32
+  accumulation; without the contract XLA may evaluate f32 dots through
+  bf16 operands inside fusions (the pre-MXU-rewrite pathology that once
+  banned dots from ops/limbs.py entirely) — silently rounding 16-bit
+  digit products.  Every live dot must route through ``limbs._dot_f32``
+  or ``fused_core._m_dot``, which both carry the contract.
 - ``jaxpr-unstable-cache-key``   a Python scalar captured as a traced
   constant (rank-0 const), or a constant set that differs between bucket
   sizes.  Captured scalars make the executable hostage to a Python value
@@ -256,7 +265,60 @@ def all_eqns(closed_jaxpr) -> List:
 # schema tag folded into the fingerprint alongside a hash of this module's
 # own source (so editing the trace inputs or extraction logic invalidates
 # the cache automatically, no manual bump required)
-_CACHE_VERSION = 2  # v2: sharded collective/final-exp ordering stats
+_CACHE_VERSION = 3  # v3: dot_general precision-contract census
+
+
+def _eqn_site(eqn) -> Tuple[str, int]:
+    """User-source (file, line) of an equation, '' / 0 when unavailable —
+    same mapping the limb-interval findings use, so the known-bad fixture
+    can pin violations to its ``# VIOLATION`` lines."""
+    try:
+        from jax._src import source_info_util
+
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is not None:
+            return frame.file_name, frame.start_line
+    except Exception:
+        pass
+    return "", 0
+
+
+def _precision_is_highest(precision) -> bool:
+    """True iff the dot's precision config pins HIGHEST on both operands.
+    The param may be None, a single Precision, or a 2-tuple; enum names
+    overlap as prefixes (HIGH vs HIGHEST) so compare full names."""
+    if precision is None:
+        return False
+    vals = precision if isinstance(precision, (tuple, list)) else (precision,)
+    names = [str(getattr(v, "name", v)).rsplit(".", 1)[-1] for v in vals]
+    return bool(names) and all(n == "HIGHEST" for n in names)
+
+
+def _dot_general_census(eqns: List) -> List[list]:
+    """One row per distinct dot_general call site:
+    [file, line, precision_is_highest, preferred_element_type_name].
+    preferred name is "" when the dot carries none."""
+    rows, seen = [], set()
+    for eqn in eqns:
+        if eqn.primitive.name != "dot_general":
+            continue
+        fname, line = _eqn_site(eqn)
+        prec_ok = _precision_is_highest(eqn.params.get("precision"))
+        pref = eqn.params.get("preferred_element_type")
+        if pref is None:
+            pref_name = ""
+        else:
+            import numpy as np
+
+            try:
+                pref_name = np.dtype(pref).name
+            except TypeError:
+                pref_name = str(pref)
+        key = (fname, line, prec_ok, pref_name)
+        if key not in seen:
+            seen.add(key)
+            rows.append([fname, line, prec_ok, pref_name])
+    return rows
 
 
 def _is_final_exp_scan(eqn) -> bool:
@@ -360,6 +422,7 @@ def extract_artifacts(closed_jaxpr) -> dict:
         "wide_dtypes": wide,
         "callbacks": callbacks,
         "rank0_consts": rank0,
+        "dot_generals": _dot_general_census(eqns),
         "const_census": _const_census(closed_jaxpr),
         "out_avals": [
             [list(a.shape), a.dtype.name] for a in closed_jaxpr.out_avals
@@ -380,6 +443,12 @@ def _ops_fingerprint() -> str:
 
     h = hashlib.sha256()
     h.update(f"v{_CACHE_VERSION}:jax={jax.__version__}:".encode())
+    # the limb-multiply mode changes every traced graph (ladder rows vs
+    # MXU dots), so a mode flip must never replay the other mode's
+    # artifacts — fold the resolved mode into the fingerprint
+    from ..ops.limbs import limb_mul_mode
+
+    h.update(f"limb_mul={limb_mul_mode()}:".encode())
     with open(os.path.abspath(__file__).replace(".pyc", ".py"), "rb") as f:
         h.update(f.read())
     ops_dir = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "ops")
@@ -517,6 +586,40 @@ def _check_callbacks(name: str, bucket: int, art: dict) -> List[Violation]:
     ]
 
 
+def _check_mxu_precision(name: str, bucket: int, art: dict) -> List[Violation]:
+    """jaxpr-mxu-precision: every dot_general in the audited graph must
+    carry the full precision contract (f32 preferred_element_type AND
+    precision=HIGHEST).  Absence is a violation even where the default
+    would happen to be exact — the contract is explicitness, so the
+    exactness argument is local to the call site and a backend/flag change
+    can never reintroduce the bf16-operand pass silently."""
+    out: List[Violation] = []
+    for fname, line, prec_ok, pref_name in art.get("dot_generals", []):
+        problems = []
+        if not prec_ok:
+            problems.append("precision is not HIGHEST on both operands")
+        if pref_name != "float32":
+            problems.append(
+                f"preferred_element_type is {pref_name or 'unset'}, "
+                "not float32"
+            )
+        if problems:
+            out.append(
+                Violation(
+                    "jaxpr-mxu-precision",
+                    fname or f"{name}@{bucket}",
+                    line,
+                    f"{name}@{bucket}: dot_general without the MXU "
+                    f"precision contract ({'; '.join(problems)}) — f32 "
+                    f"dots may be evaluated through bf16 operands inside "
+                    f"fusions, rounding 16-bit digit products; route the "
+                    f"contraction through limbs._dot_f32 or "
+                    f"fused_core._m_dot",
+                )
+            )
+    return out
+
+
 def _const_census(closed_jaxpr) -> List[list]:
     """Sorted multiset of [shape, dtype-name] over the trace's constants
     (JSON-native so cached and fresh censuses compare equal)."""
@@ -629,6 +732,7 @@ def audit_entry(
             out.extend(_check_concat(name, b, arts[b]))
         out.extend(_check_wide_dtypes(name, b, arts[b]))
         out.extend(_check_callbacks(name, b, arts[b]))
+        out.extend(_check_mxu_precision(name, b, arts[b]))
         if meta.get("sharded"):
             out.extend(check_sharded_rules(name, b, arts[b]))
     out.extend(_check_cache_keys(name, buckets, arts))

@@ -17,6 +17,13 @@ limb axis by construction):
 
 - elementwise arithmetic, shape ops, reductions, ``dot_general``,
   scatter/gather and ``select_n`` propagate intervals directly;
+- ``dot_general`` against a KNOWN CONSTANT operand (the MXU limb-multiply
+  mapping: one-hot REP/TIL/ACC contractions, the RED fold matrix) is
+  bounded per output column from the constant's actual positive/negative
+  column sums — max_c(hi * P_c - lo * N_c) — instead of the generic
+  interval-product times contraction-size rule, which over-approximates a
+  one-hot contraction by the full contraction width (2500x for the flat
+  outer product) and would falsely flag the MXU path;
 - ``scan``/``while`` bodies run to an inductive fixpoint (the carry
   interval is widened to TOP if it fails to stabilize, so the analysis
   always terminates and never *under*-approximates);
@@ -96,11 +103,17 @@ class LimbReport:
 
 
 class _Analyzer:
+    # constants above this size are not retained for the const-aware
+    # dot_general rule (memory bound; far above the 2500x99 MXU one-hots)
+    _CONST_VAL_MAX_SIZE = 1 << 22
+
     def __init__(self):
         self.findings: List[Finding] = []
         self.float_outputs = 0
         self.bounded_outputs = 0
         self._flagged_lines: set = set()
+        # constvar -> actual numpy array, for const-aware dot bounds
+        self._const_vals: Dict = {}
 
     # -- source mapping ---------------------------------------------------
     @staticmethod
@@ -220,6 +233,8 @@ class _Analyzer:
                 arr = np.asarray(c)
                 env[var] = (float(arr.min()), float(arr.max())) if arr.size \
                     else (0.0, 0.0)
+                if 0 < arr.size <= self._CONST_VAL_MAX_SIZE:
+                    self._const_vals[var] = arr
             except Exception:
                 env[var] = TOP
         for var, iv in zip(jaxpr.invars, in_intervals):
@@ -227,7 +242,48 @@ class _Analyzer:
         for eqn in jaxpr.eqns:
             outvals = self._eval_eqn(eqn, env, defs)
             self._record(eqn, outvals, env, defs)
+            self._fwd_const(eqn)
         return [self._read(env, defs, v) for v in jaxpr.outvars]
+
+    def _fwd_const(self, eqn):
+        """Keep the const-aware dot rule's view of a constant alive across
+        value-preserving plumbing (jnp.asarray of a host constant traces as
+        device_put; casts and layout moves likewise)."""
+        import numpy as np
+
+        if len(eqn.outvars) != 1 or not eqn.invars:
+            return
+        name = eqn.primitive.name
+        arr = self._const_arr(eqn.invars[0])
+        if arr is None:
+            return
+        try:
+            if name in ("device_put", "copy", "stop_gradient"):
+                self._const_vals[eqn.outvars[0]] = arr
+            elif name == "convert_element_type":
+                # bound the CONVERTED values (a narrowing cast may round)
+                self._const_vals[eqn.outvars[0]] = np.asarray(arr).astype(
+                    eqn.params["new_dtype"]
+                )
+            elif name == "transpose":
+                self._const_vals[eqn.outvars[0]] = np.transpose(
+                    arr, eqn.params.get("permutation")
+                )
+            elif name == "reshape":
+                self._const_vals[eqn.outvars[0]] = np.reshape(
+                    arr, eqn.params["new_sizes"]
+                )
+        except Exception:
+            pass
+
+    def _seed_consts(self, analyzer, outer_atoms, inner_vars):
+        """Forward statically-known arrays across a call/control-flow
+        boundary (pjit consts are lifted into invars; scan/cond/while pass
+        their closure constants positionally)."""
+        for outer, inner in zip(outer_atoms, inner_vars):
+            arr = self._const_arr(outer)
+            if arr is not None:
+                analyzer._const_vals[inner] = arr
 
     def _subjaxpr(self, closed, in_ivs):
         return self.run(closed.jaxpr, closed.consts, in_ivs)
@@ -341,10 +397,7 @@ class _Analyzer:
         if name == "reduce_prod":
             return [TOP]
         if name == "dot_general":
-            k = self._contract_size(eqn)
-            span = self._eval_mul_for_dot(ins[0], ins[1])
-            return [(span[0] * k if span[0] < 0 else span[0],
-                     span[1] * k if span[1] > 0 else span[1])]
+            return [self._dot_interval(eqn, ins)]
         if name in ("pjit", "closed_call", "core_call", "remat",
                     "remat_call", "custom_jvp_call", "custom_vjp_call",
                     "custom_jvp_call_jaxpr", "checkpoint"):
@@ -352,12 +405,15 @@ class _Analyzer:
             if closed is None:
                 return [TOP] * n_out
             if hasattr(closed, "jaxpr"):
+                self._seed_consts(self, eqn.invars, closed.jaxpr.invars)
                 return self._subjaxpr(closed, ins)
+            self._seed_consts(self, eqn.invars, closed.invars)
             return self.run(closed, [], ins)
         if name == "cond":
             branches = eqn.params.get("branches") or ()
             outs = None
             for br in branches:
+                self._seed_consts(self, eqn.invars[1:], br.jaxpr.invars)
                 o = self._subjaxpr(br, ins[1:])
                 outs = o if outs is None else [
                     _union(a, b) for a, b in zip(outs, o)
@@ -380,6 +436,61 @@ class _Analyzer:
         cands = [a[0] * b[0], a[0] * b[1], a[1] * b[0], a[1] * b[1]]
         cands = [c if not math.isnan(c) else 0.0 for c in cands]
         return (min(cands), max(cands))
+
+    def _const_arr(self, v):
+        """The actual array behind a jaxpr atom, if statically known."""
+        from jax._src import core as jcore
+
+        if isinstance(v, jcore.Literal):
+            import numpy as np
+
+            try:
+                arr = np.asarray(v.val)
+                return arr if 0 < arr.size <= self._CONST_VAL_MAX_SIZE else None
+            except Exception:
+                return None
+        return self._const_vals.get(v)
+
+    def _dot_interval(self, eqn, ins):
+        """dot_general bounds.
+
+        When one operand is a known constant W (the MXU mapping's one-hot
+        REP/TIL/ACC and placement matrices, the RED fold rows), each output
+        column c is sum_j W[j, c] * x_j with x_j in [lo, hi], so the exact
+        interval hull is
+            [ min_c(lo * P_c - hi * N_c),  max_c(hi * P_c - lo * N_c) ]
+        with P_c / N_c the positive/negative parts of W summed over the
+        contracted axes.  For a one-hot column this is just [lo, hi] —
+        whereas the generic fallback (interval product x contraction size)
+        multiplies by the full contraction width and cannot prove the MXU
+        path.  Fallback keeps the old sound over-approximation when
+        neither operand is statically known.
+        """
+        import numpy as np
+
+        dn = eqn.params.get("dimension_numbers")
+        if dn is not None:
+            (lcd, rcd), (lbd, rbd) = dn
+            for cidx, vidx, caxes in ((1, 0, tuple(rcd)), (0, 1, tuple(lcd))):
+                arr = self._const_arr(eqn.invars[cidx])
+                if arr is None:
+                    continue
+                lo, hi = ins[vidx]
+                if not (math.isfinite(lo) and math.isfinite(hi)):
+                    break  # unknown operand range: no better than fallback
+                w = np.asarray(arr, dtype=np.float64)
+                pos = np.maximum(w, 0.0)
+                neg = np.maximum(-w, 0.0)
+                if caxes:
+                    pos = pos.sum(axis=caxes)
+                    neg = neg.sum(axis=caxes)
+                out_lo = float(np.min(lo * pos - hi * neg)) if pos.size else 0.0
+                out_hi = float(np.max(hi * pos - lo * neg)) if pos.size else 0.0
+                return (min(out_lo, out_hi), max(out_lo, out_hi))
+        k = self._contract_size(eqn)
+        span = self._eval_mul_for_dot(ins[0], ins[1])
+        return (span[0] * k if span[0] < 0 else span[0],
+                span[1] * k if span[1] > 0 else span[1])
 
     @staticmethod
     def _reduced_size(eqn) -> int:
@@ -422,6 +533,7 @@ class _Analyzer:
         # pre-widening transients don't fire)
         for _ in range(_SCAN_FIXPOINT_ITERS):
             sub = _Analyzer()
+            self._seed_consts(sub, eqn.invars[:n_consts], closed.jaxpr.invars)
             outs = sub.run(closed.jaxpr, closed.consts, consts + carry + xs)
             new_carry = [
                 _union(c, o) for c, o in zip(carry, outs[:n_carry])
@@ -431,6 +543,7 @@ class _Analyzer:
             carry = new_carry
         else:
             carry = [TOP] * n_carry
+        self._seed_consts(self, eqn.invars[:n_consts], closed.jaxpr.invars)
         final = self._subjaxpr(closed, consts + carry + xs)
         carry_out = [_union(c, o) for c, o in zip(carry, final[:n_carry])]
         ys = final[n_carry:]
@@ -446,6 +559,9 @@ class _Analyzer:
         carry = list(ins[cn + bn:])
         for _ in range(_SCAN_FIXPOINT_ITERS):
             sub = _Analyzer()
+            self._seed_consts(
+                sub, eqn.invars[cn:cn + bn], closed.jaxpr.invars
+            )
             outs = sub.run(closed.jaxpr, closed.consts, consts + carry)
             new_carry = [_union(c, o) for c, o in zip(carry, outs)]
             if new_carry == carry:
@@ -453,6 +569,7 @@ class _Analyzer:
             carry = new_carry
         else:
             carry = [TOP] * len(carry)
+        self._seed_consts(self, eqn.invars[cn:cn + bn], closed.jaxpr.invars)
         final = self._subjaxpr(closed, consts + carry)
         return [_union(c, o) for c, o in zip(carry, final)]
 
@@ -516,7 +633,25 @@ def limb_entries() -> List[LimbEntry]:
                   contract="a digits < 2^23, b digits < 2^12"),
         LimbEntry("fp_mul", lambda a, b: fl.fp_mul(a, b),
                   [(N,), (N,)], [STRICT, STRICT],
-                  contract="strict x strict schoolbook"),
+                  contract="strict x strict schoolbook (env-selected mode)"),
+        # every LODESTAR_TPU_LIMB_MUL mode is proven individually — the
+        # env default must never be the only path with a digit proof
+        LimbEntry("fp_mul@ladder", lambda a, b: fl.fp_mul(a, b, mode="ladder"),
+                  [(N,), (N,)], [STRICT, STRICT],
+                  contract="strict x strict, VPU pad+add ladder"),
+        LimbEntry("fp_mul@mxu", lambda a, b: fl.fp_mul(a, b, mode="mxu"),
+                  [(N,), (N,)], [STRICT, STRICT],
+                  contract="strict x strict, one-hot MXU contraction"),
+        LimbEntry("fp_mul@mxu9", lambda a, b: fl.fp_mul(a, b, mode="mxu9"),
+                  [(N,), (N,)], [STRICT, STRICT],
+                  contract="strict x strict, 9-bit re-packed contraction"),
+        LimbEntry("pack9", fl._pack9, [(N,)], [STRICT],
+                  contract="strict 8-bit digits -> 45 x 9-bit digits"),
+        LimbEntry("carry_base512",
+                  lambda x: fl._carry_base(x, fl.LOOSE_BITS, fl.PACK9_BITS),
+                  [(2 * fl.PACK9_NLIMBS - 1,)],
+                  [(0.0, float(fl.PACK9_NLIMBS * (1 << 18)))],
+                  contract="base-512 carry at the mxu9 product bound"),
         LimbEntry("fp_sqr", lambda a: fl.fp_sqr(a), [(N,)], [STRICT],
                   contract="strict square"),
         LimbEntry("fp_mul_small", lambda a: fl.fp_mul_small(a, (1 << 14) - 1),

@@ -50,6 +50,10 @@ TRIPWIRES: Dict[str, Tuple[int, float]] = {
     "epoch_transition_ms_250k": (-1, 0.25),
     "sustained_sets_per_s_at_slo": (+1, 0.10),
     "dispatch_ms": (-1, 0.15),
+    # PR-18 MXU limb multiply: measured ladder->MXU fp_mul speedup from
+    # the bench limb_mul microbench; a drop means the dot path lost its
+    # edge over the VPU ladder (compiler regression or contract slip)
+    "fp_mul_speedup_mxu": (+1, 0.10),
 }
 
 #: a tier-1 ledger entry counts as a FULL suite run at or above this many
@@ -96,6 +100,16 @@ def load_series(repo: str, pattern: str = "BENCH_r*.json") -> List[dict]:
     return sorted(out, key=lambda d: d["_run"])
 
 
+def run_backend(run: dict) -> Optional[str]:
+    """The backend a run record measured on (extras.backend; None for
+    pre-backend-stamp records).  Cross-backend throughput comparisons are
+    meaningless — a CPU-host run (no accelerator attached, e.g. the r05
+    libtpu-init class) must not read as a "regression" against a TPU
+    series, nor silently extend a TPU plateau — so trend verdicts and
+    perf deltas only ever compare same-backend runs."""
+    return _get(run, "parsed", "extras", "backend")
+
+
 def extract_metrics(run: dict) -> Dict[str, Optional[float]]:
     """Flatten one BENCH run record into the metric paths TRIPWIRES
     names (None = the run produced no value — a gap, not a zero)."""
@@ -127,6 +141,7 @@ def extract_metrics(run: dict) -> Dict[str, Optional[float]]:
         "epoch_transition_ms_250k": _get(ex, "scale_250k", "epoch_transition_ms_250k"),
         "sustained_sets_per_s_at_slo": fh.get("sustained_sets_per_s_at_slo"),
         "dispatch_ms": ex.get("dispatch_ms"),
+        "fp_mul_speedup_mxu": _get(ex, "limb_mul", "fp_mul_speedup_mxu"),
     }
 
 
@@ -152,10 +167,21 @@ def trend_metric(
     direction: int,
     threshold: float,
     plateau: bool = False,
+    backends: Optional[List[Optional[str]]] = None,
 ) -> Dict[str, Any]:
-    """Trend verdict for one metric over (run, value|None) points."""
+    """Trend verdict for one metric over (run, value|None) points.
+
+    ``backends`` (aligned with ``points``, see :func:`run_backend`)
+    partitions the series: regressions, noise bands, and plateaus are
+    only ever computed WITHIN one backend's sub-series and the flags
+    unioned — a backend switch (TPU host -> CPU host) is a measurement-
+    context change, not a performance event.  ``None`` backends form
+    their own group, so pre-stamp series behave exactly as before.
+    """
     gaps = [r for r, v in points if v is None]
     series = [(r, float(v)) for r, v in points if v is not None]
+    bk = backends if backends is not None else [None] * len(points)
+    series_bk = [b for (r, v), b in zip(points, bk) if v is not None]
     out: Dict[str, Any] = {
         "points": {f"r{r:02d}": v for r, v in series},
         "gaps": [f"r{r:02d}" for r in gaps],
@@ -164,30 +190,53 @@ def trend_metric(
     if not series:
         return out
     runs, values = zip(*series)
-    last = values[-1]
-    out["last"] = last
+    out["last"] = values[-1]
     out["best"] = max(values) if direction > 0 else min(values)
-    if len(values) >= 2:
-        prev = values[-2]
-        delta = (last - prev) / abs(prev) if prev else 0.0
-        out["delta_vs_prev_pct"] = round(delta * 100, 1)
-        band = _noise_band(list(values[:-1]))
-        out["noise_band_pct"] = round(band * 100, 1)
-        # "moved against the good direction": direction*delta < 0
-        if direction * delta < 0 and abs(delta) >= max(threshold, band):
-            out["flags"].append("regression")
-        # ratchet check vs the best-ever too: a slow multi-run bleed
-        # passes every pairwise check but still loses the threshold
-        best = out["best"]
-        slump = (last - best) / abs(best) if best else 0.0
-        if direction * slump < 0 and abs(slump) >= max(threshold, band) and \
-                "regression" not in out["flags"]:
-            out["flags"].append("regression_vs_best")
-    if plateau and len(values) >= PLATEAU_RUNS:
-        tail = values[-PLATEAU_RUNS:]
-        mid = sorted(tail)[len(tail) // 2]
-        if mid and all(abs(v - mid) / abs(mid) <= PLATEAU_BAND for v in tail):
-            out["flags"].append("plateau")
+
+    def _judge(vals):
+        """(flags, delta_pct, band_pct) over one same-backend sub-series."""
+        flags = []
+        delta_pct = band_pct = None
+        if len(vals) >= 2:
+            last, prev = vals[-1], vals[-2]
+            delta = (last - prev) / abs(prev) if prev else 0.0
+            delta_pct = round(delta * 100, 1)
+            band = _noise_band(list(vals[:-1]))
+            band_pct = round(band * 100, 1)
+            # "moved against the good direction": direction*delta < 0
+            if direction * delta < 0 and abs(delta) >= max(threshold, band):
+                flags.append("regression")
+            # ratchet check vs the best-ever too: a slow multi-run bleed
+            # passes every pairwise check but still loses the threshold
+            best = max(vals) if direction > 0 else min(vals)
+            slump = (last - best) / abs(best) if best else 0.0
+            if direction * slump < 0 and abs(slump) >= max(threshold, band) \
+                    and "regression" not in flags:
+                flags.append("regression_vs_best")
+        if plateau and len(vals) >= PLATEAU_RUNS:
+            tail = vals[-PLATEAU_RUNS:]
+            mid = sorted(tail)[len(tail) // 2]
+            if mid and all(abs(v - mid) / abs(mid) <= PLATEAU_BAND for v in tail):
+                flags.append("plateau")
+        return flags, delta_pct, band_pct
+
+    # group the measured values by backend, preserving run order
+    groups: Dict[Optional[str], List[float]] = {}
+    for v, b in zip(values, series_bk):
+        groups.setdefault(b, []).append(v)
+    last_backend = series_bk[-1]
+    for b, vals in groups.items():
+        flags, delta_pct, band_pct = _judge(vals)
+        for f in flags:
+            if f not in out["flags"]:
+                out["flags"].append(f)
+        # the headline delta/noise columns describe the CURRENT context:
+        # the sub-series the latest measurement belongs to
+        if b == last_backend:
+            if delta_pct is not None:
+                out["delta_vs_prev_pct"] = delta_pct
+            if band_pct is not None:
+                out["noise_band_pct"] = band_pct
     return out
 
 
@@ -197,6 +246,7 @@ def analyze(repo: str, bench_pattern: str = "BENCH_r*.json",
     multichip dryrun series, compile-ledger + tier-1 sidecars."""
     runs = load_series(repo, bench_pattern)
     per_run = [(r["_run"], extract_metrics(r)) for r in runs]
+    backends = [run_backend(r) for r in runs]
     crashed = [
         {"run": f"r{r['_run']:02d}", "rc": r.get("rc"),
          "file": r.get("_path")}
@@ -206,7 +256,8 @@ def analyze(repo: str, bench_pattern: str = "BENCH_r*.json",
     for name, (direction, threshold) in TRIPWIRES.items():
         points = [(run, vals.get(name)) for run, vals in per_run]
         metrics[name] = trend_metric(
-            points, direction, threshold, plateau=name in PLATEAU_METRICS
+            points, direction, threshold, plateau=name in PLATEAU_METRICS,
+            backends=backends,
         )
     dryruns = [
         {"run": f"r{r['_run']:02d}", "ok": bool(r.get("ok")),
@@ -273,10 +324,19 @@ def _sidecar_tier1(repo: str) -> Optional[dict]:
 
 
 def deltas_vs_previous(repo: str, current: Dict[str, Optional[float]],
-                       bench_pattern: str = "BENCH_r*.json") -> Dict[str, Any]:
+                       bench_pattern: str = "BENCH_r*.json",
+                       backend: Optional[str] = None) -> Dict[str, Any]:
     """bench.py's extras.perf_deltas: each current metric vs the most
-    recent committed run that produced it, with the tripwire verdict."""
+    recent committed run that produced it, with the tripwire verdict.
+
+    ``backend`` (the live ``jax.default_backend()``) restricts the
+    comparison series to committed runs measured on the same backend —
+    see :func:`run_backend`.  ``None`` keeps the whole series (legacy
+    records and tests without a backend stamp).
+    """
     runs = load_series(repo, bench_pattern)
+    if backend is not None:
+        runs = [r for r in runs if run_backend(r) == backend]
     out: Dict[str, Any] = {}
     for name, now in current.items():
         if now is None or name not in TRIPWIRES:

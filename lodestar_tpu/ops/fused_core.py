@@ -235,22 +235,17 @@ def lc(x: LV, i: int, axis: int = -2) -> LV:
 # chained products (.probe/r5_mxu.py).
 # ---------------------------------------------------------------------------
 
-_ACCW = 2 * NL - 1  # 99
+_ACCW = fl.MXU_ACC_W  # 99
 
-# anti-diagonal accumulation one-hot: W[(i*NL+j), i+j] = 1
-_W_MAT = np.zeros((NL * NL, _ACCW), np.float32)
-for _i in range(NL):
-    for _j in range(NL):
-        _W_MAT[_i * NL + _j, _i + _j] = 1.0
-
+# One-hot matmul masters are defined once in limbs.py (the XLA-graph MXU
+# fp_mul path uses the same REP/TIL/ACC mapping); this module only re-casts
+# them to bf16 for the in-kernel DMA budget.  Values are identical to the
+# loops that used to live here, so kernel graphs are unchanged.
+_W_MAT = fl.MXU_ACC  # anti-diagonal accumulation one-hot: W[(i*NL+j), i+j] = 1
 # repeat/tile one-hots (Mosaic cannot reshape (B,50,50)->(B,2500); the
 # flat outer product is built as (a @ REP) * (b @ TIL) instead)
-_REP_MAT = np.zeros((NL, NL * NL), np.float32)
-_TIL_MAT = np.zeros((NL, NL * NL), np.float32)
-for _i in range(NL):
-    for _j in range(NL):
-        _REP_MAT[_i, _i * NL + _j] = 1.0
-        _TIL_MAT[_j, _i * NL + _j] = 1.0
+_REP_MAT = fl.MXU_REP
+_TIL_MAT = fl.MXU_TIL
 
 # fold matrix: digit positions 0..48 pass through, 49.. fold via RED rows
 _FOLD_W = 102
@@ -290,11 +285,15 @@ _MC_CONSTS = (
 
 def _m_dot(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
     """bf16 x bf16 -> f32 matmul; exact when both sides are integers
-    <= 2^8 and output sums < 2^24."""
+    <= 2^8 and output sums < 2^24.  Carries the full MXU precision
+    contract (preferred_element_type pins the f32 accumulator; HIGHEST is
+    a no-op for bf16 operands but keeps every live dot_general uniform
+    under the jaxpr-mxu-precision rule)."""
     return jax.lax.dot_general(
         x.astype(_BF),
         w.astype(_BF),
         (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=jnp.float32,
     )
 

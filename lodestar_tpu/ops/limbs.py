@@ -24,10 +24,21 @@ arithmetic core whose exactness depends only on f32 adds/muls/floors below
 2^24 has no emulation path to miscompile, and it dodges uint32 entirely.
 
 Machine mapping:
-- fp_mul: one broadcasted outer product (f32, exact) contracted against a
-  constant one-hot anti-diagonal tensor — an MXU-shaped einsum XLA may
-  lower to a dot or to 50 shifted vector adds; both are exact at our
-  magnitudes and both vectorize over the batch lanes.
+- fp_mul: the 50x50 schoolbook digit product IS a small dense matmul, and
+  (as of the MXU rewrite) runs as three explicit ``lax.dot_general`` calls
+  against constant one-hot matrices: replicate a, tile b, multiply
+  elementwise (f32 products < 2^16, exact), then contract the (..., 2500)
+  flat outer product against a one-hot anti-diagonal accumulator
+  (each output <= 50 * 2^16 < 2^22, exact).  Every dot carries the
+  PRECISION CONTRACT — ``preferred_element_type=jnp.float32`` plus
+  ``precision=lax.Precision.HIGHEST`` — so the bf16-operand pass XLA may
+  otherwise use for f32 dots inside fusions is excluded by construction
+  (statically enforced by the jaxpr-mxu-precision lint rule).  The
+  original VPU pad+add ladder remains as a selectable fallback, and an
+  experimental 9-bit re-packed variant shrinks the contraction
+  (LODESTAR_TPU_LIMB_MUL=ladder|mxu|mxu9; unset = mxu on TPU backends,
+  ladder elsewhere — off-TPU the one-hot dots are dense matmuls with no
+  matrix unit to absorb them).
 - carries: branch-free.  Three value-preserving digit folds (hi =
   floor(d/256)) shrink any <2^24 digit to <= 257, then a Kogge-Stone
   generate/propagate closure resolves the residual 0/1 ripple in
@@ -48,6 +59,7 @@ and (via the same tests run under JAX_PLATFORMS=tpu) on device.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Sequence
 
@@ -167,6 +179,152 @@ def _sub_pad(w: int) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# MXU mapping: mode selector, precision contract, one-hot constants
+# ---------------------------------------------------------------------------
+
+# The schoolbook digit product is a small dense matmul; on TPU it belongs on
+# the matrix unit.  LODESTAR_TPU_LIMB_MUL selects the implementation:
+#   mxu    (default on TPU) — three f32 dot_generals against constant one-hots
+#   ladder (default off-TPU) — the original VPU broadcast-multiply + pad+add
+#   mxu9             — experimental: re-pack 50x8-bit digits into 45x9-bit
+#                      digits first, shrinking the contraction (2025 vs 2500
+#                      flat products); proven sound by analysis/limb_interval
+# The unset-env default is BACKEND-AWARE: the one-hot contraction is only a
+# win where a matrix unit exists to absorb it — on CPU/GPU backends the same
+# dots lower to dense (B, 2500) @ (2500, 99) matmuls against mostly-zero
+# constants and measurably LOSE to the sparse-aware ladder (bench.py's
+# limb_mul stage records the ratio per backend).  The env var always
+# overrides, and every mode is read PER CALL at trace time and passed into
+# the jitted implementations as a static argument, so the jit cache key
+# carries the mode and a flip can never reuse a stale program.
+_LIMB_MUL_MODES = ("ladder", "mxu", "mxu9")
+_BACKEND_DEFAULT_CACHE: dict = {}
+
+
+def _backend_default_mode() -> str:
+    if "mode" not in _BACKEND_DEFAULT_CACHE:
+        try:
+            backend = jax.default_backend()
+        except Exception:  # no backend at all: the ladder needs none
+            backend = "cpu"
+        _BACKEND_DEFAULT_CACHE["mode"] = "mxu" if backend == "tpu" else "ladder"
+    return _BACKEND_DEFAULT_CACHE["mode"]
+
+
+def _resolve_limb_mul_mode(mode=None) -> str:
+    if mode is None:
+        mode = os.environ.get("LODESTAR_TPU_LIMB_MUL") or _backend_default_mode()
+    mode = str(mode).strip().lower()
+    if mode not in _LIMB_MUL_MODES:
+        raise ValueError(
+            f"LODESTAR_TPU_LIMB_MUL must be one of {_LIMB_MUL_MODES}, got {mode!r}"
+        )
+    return mode
+
+
+def limb_mul_mode() -> str:
+    """The multiply implementation fp_mul resolves for this call."""
+    return _resolve_limb_mul_mode(None)
+
+
+# One-hot masters for the MXU mapping (f32; fused_core derives its bf16
+# copies from these so both layers share one definition).  Mosaic cannot
+# reshape (..., 50, 50) -> (..., 2500), so the flat outer product is built
+# as (a @ REP) * (b @ TIL): REP replicates each a-digit across a 50-wide
+# block, TIL tiles b across the blocks, and ACC is the one-hot
+# anti-diagonal accumulator ACC[i*50+j, i+j] = 1 contracting the 2500 flat
+# products into the 99 result columns.
+MXU_ACC_W = 2 * NLIMBS - 1  # 99
+MXU_REP = np.zeros((NLIMBS, NLIMBS * NLIMBS), dtype=NP_DTYPE)
+MXU_TIL = np.zeros((NLIMBS, NLIMBS * NLIMBS), dtype=NP_DTYPE)
+MXU_ACC = np.zeros((NLIMBS * NLIMBS, MXU_ACC_W), dtype=NP_DTYPE)
+for _i in range(NLIMBS):
+    for _j in range(NLIMBS):
+        MXU_REP[_i, _i * NLIMBS + _j] = 1.0
+        MXU_TIL[_j, _i * NLIMBS + _j] = 1.0
+        MXU_ACC[_i * NLIMBS + _j, _i + _j] = 1.0
+
+
+def _dot_f32(x: jnp.ndarray, w) -> jnp.ndarray:
+    """dot_general under the MXU PRECISION CONTRACT.
+
+    ``preferred_element_type=jnp.float32`` pins the accumulator dtype and
+    ``precision=lax.Precision.HIGHEST`` forbids the bf16-operand pass XLA
+    may otherwise apply to f32 dots inside fusions — the rounding pathology
+    the pre-MXU ladder avoided by avoiding dots entirely.  With both
+    attributes the contraction is exact for every integer operand < 2^24,
+    which analysis/limb_interval proves for all callers.  Enforced
+    statically by the jaxpr-mxu-precision rule; ``w`` must be a long-lived
+    module-level constant (see the constant-stability rule at RED_ROWS).
+    """
+    return lax.dot_general(
+        x,
+        jnp.asarray(w),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        precision=lax.Precision.HIGHEST,
+        preferred_element_type=DTYPE,
+    )
+
+
+# --- 9-bit re-packing (mode "mxu9") -----------------------------------------
+# b = 9 is the unique wider f32-exact packing: products of b-bit digits
+# summed over ceil(400/b) anti-diagonal terms need 2b + log2(ceil(400/b))
+# < 24, which holds for b <= 9 only.  Packing is an arithmetic scatter, NOT
+# bit extraction: semi-strict digits reach 256 (the carry fixed point), so
+# slicing bits would not be value-preserving.  Each 8-bit digit i (weight
+# 2^{8i} = 2^{9q+r}, q = 8i//9, r = 8i mod 9) is shifted by 2^r, split at
+# the base-512 boundary, and the lo/hi parts land in 9-bit digits q / q+1
+# via one-hot placement dots; a base-512 carry pass then restores digits
+# <= 512.
+PACK9_BITS = 9
+PACK9_NLIMBS = -(-VALUE_BITS // PACK9_BITS)  # 45
+_P9_BASE = float(1 << PACK9_BITS)
+_P9_INV = 1.0 / _P9_BASE
+_P9_ACC_W = 2 * PACK9_NLIMBS - 1  # 89
+
+_P9_SHIFT = np.array(
+    [float(1 << ((LIMB_BITS * i) % PACK9_BITS)) for i in range(NLIMBS)],
+    dtype=NP_DTYPE,
+)
+_P9_LO = np.zeros((NLIMBS, PACK9_NLIMBS), dtype=NP_DTYPE)
+_P9_HI = np.zeros((NLIMBS, PACK9_NLIMBS), dtype=NP_DTYPE)
+for _i in range(NLIMBS):
+    _q = (LIMB_BITS * _i) // PACK9_BITS  # <= 43, so _q + 1 fits width 45
+    _P9_LO[_i, _q] = 1.0
+    _P9_HI[_i, _q + 1] = 1.0
+
+MXU9_REP = np.zeros((PACK9_NLIMBS, PACK9_NLIMBS * PACK9_NLIMBS), dtype=NP_DTYPE)
+MXU9_TIL = np.zeros((PACK9_NLIMBS, PACK9_NLIMBS * PACK9_NLIMBS), dtype=NP_DTYPE)
+MXU9_ACC = np.zeros((PACK9_NLIMBS * PACK9_NLIMBS, _P9_ACC_W), dtype=NP_DTYPE)
+for _i in range(PACK9_NLIMBS):
+    for _j in range(PACK9_NLIMBS):
+        MXU9_REP[_i, _i * PACK9_NLIMBS + _j] = 1.0
+        MXU9_TIL[_j, _i * PACK9_NLIMBS + _j] = 1.0
+        MXU9_ACC[_i * PACK9_NLIMBS + _j, _i + _j] = 1.0
+
+# unpack constants per input width (cached long-lived objects — see the
+# constant-stability rule at RED_ROWS)
+_U9_CACHE: dict = {}
+
+
+def _unpack9_mats(w9: int):
+    if w9 not in _U9_CACHE:
+        w256 = (PACK9_BITS * (w9 - 1)) // LIMB_BITS + 2
+        shift = np.array(
+            [float(1 << ((PACK9_BITS * j) % LIMB_BITS)) for j in range(w9)],
+            dtype=NP_DTYPE,
+        )
+        lo = np.zeros((w9, w256), dtype=NP_DTYPE)
+        hi = np.zeros((w9, w256), dtype=NP_DTYPE)
+        for j in range(w9):
+            q = (PACK9_BITS * j) // LIMB_BITS
+            lo[j, q] = 1.0
+            hi[j, q + 1] = 1.0
+        _U9_CACHE[w9] = (shift, lo, hi)
+    return _U9_CACHE[w9]
+
+
+# ---------------------------------------------------------------------------
 # carries and normalization (branch-free: no scans, no conds)
 # ---------------------------------------------------------------------------
 
@@ -216,17 +374,31 @@ def carry_exact(x: jnp.ndarray, bound_bits: int = LOOSE_BITS) -> jnp.ndarray:
     ladder to mis-fuse, costs fewer ops, and needs no ripple closure at
     all because <= 256 is closed under every op contract in this module.
     """
+    return _carry_base(x, bound_bits, LIMB_BITS)
+
+
+def _carry_base(x: jnp.ndarray, bound_bits: int, limb_bits: int) -> jnp.ndarray:
+    """carry_exact generalized to an arbitrary digit base 2^limb_bits.
+
+    Same fold ladder and same fixed point, parameterized: digits shrink as
+    b -> (2^limb_bits - 1) + b/2^limb_bits, whose fixed point is
+    2^limb_bits.  Used at base 512 by the 9-bit re-packed multiply path
+    (mode "mxu9"); carry_exact is the base-256 instance.
+    """
     if bound_bits > LOOSE_BITS:
         raise ValueError("digits exceed the f32-exact range")
     # enough headroom digits that the top carry is never truncated:
-    # value < 2^(8*(W-1)) * 2^bound_bits
-    extra = max(1, -(-(bound_bits - LIMB_BITS) // LIMB_BITS))
+    # value < 2^(limb_bits*(W-1)) * 2^bound_bits
+    extra = max(1, -(-(bound_bits - limb_bits) // limb_bits))
     x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, extra)])
+    base = float(1 << limb_bits)
+    inv = 1.0 / base  # exact power of two
+    cap = 1 << limb_bits
     b = (1 << bound_bits) - 1  # integer digit bound
-    while b > 256:
-        lo, hi = _split(x)
-        x = lo + _shift_up(hi, 1)
-        b = 255 + b // (1 << LIMB_BITS)
+    while b > cap:
+        hi = jnp.floor(x * inv)
+        x = (x - hi * base) + _shift_up(hi, 1)
+        b = (cap - 1) + b // cap
     return x
 
 
@@ -256,11 +428,15 @@ def _fold_tail(y: jnp.ndarray) -> jnp.ndarray:
     """
     k = y.shape[-1] - _FOLD_BASE
     hi = y[..., _FOLD_BASE:]
-    # Per-row multiply-adds, NO dot: XLA's dot rewrites inside large fused
-    # graphs can drop the HIGHEST-precision attribute and evaluate f32 dots
-    # through bf16 operands (observed on both CPU and TPU backends), which
-    # silently rounds the 16-bit digit products.  Elementwise mul/add have
-    # no such downcast path and vectorize over the batch lanes just as well.
+    # Per-row multiply-adds rather than a dot: the fold is <= 54 rows (tiny
+    # next to the 2500-wide product contraction that now runs on the MXU
+    # under the _dot_f32 precision contract), and keeping it elementwise
+    # leaves fp_sub/fp_strict — which share _finalize but never multiply —
+    # free of dot_generals entirely.  Historical note: before the precision
+    # contract existed, dots were banned module-wide because XLA could
+    # evaluate f32 dots through bf16 operands inside fusions; that rationale
+    # is superseded by _dot_f32's explicit HIGHEST + preferred_element_type
+    # attributes, enforced by the jaxpr-mxu-precision rule.
     e = jnp.zeros(y.shape[:-1] + (NLIMBS,), dtype=DTYPE)
     for r in range(k):
         e = e + _digit(hi, r) * jnp.asarray(RED_ROWS[r])
@@ -331,27 +507,16 @@ def fp_mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
     return _finalize(a * DTYPE(k), 22)
 
 
-@partial(jax.jit, static_argnames=("a_strict", "b_strict"))
-def fp_mul(
-    a: jnp.ndarray, b: jnp.ndarray, *, a_strict: bool = True, b_strict: bool = True
-) -> jnp.ndarray:
-    """a * b mod p -> strict (..., 50).
+def _mul_digits_ladder(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """VPU fallback: schoolbook via 50 shifted row adds (mode "ladder").
 
-    Inputs must be strict (digits < 2^8); pass ``a_strict=False`` /
-    ``b_strict=False`` to have them re-normalized here.  Schoolbook 50x50
-    digit products (f32, < 2^16 each, exact) summed along anti-diagonals by
-    the constant one-hot einsum (each output < 50 * 2^16 < 2^22), then
-    folded below 2^400 via the RED table inside _finalize.
+    Each row a_i * b is one broadcasted f32 multiply (< 2^16, exact); the
+    pad+add ladder accumulates the anti-diagonals with every partial sum
+    <= 50 * 2^16 < 2^22, exact.  This was the only implementation before
+    the MXU precision contract (_dot_f32) made dots safe; it stays
+    selectable (LODESTAR_TPU_LIMB_MUL=ladder) as the oracle-differential
+    control for the dot paths.
     """
-    if not a_strict:
-        a = fp_strict(a)
-    if not b_strict:
-        b = fp_strict(b)
-    # Schoolbook via 50 shifted row adds — deliberately NO dot/einsum (see
-    # _fold_tail: XLA may evaluate f32 dots through bf16 inside fusions,
-    # rounding the 16-bit products).  Each row a_i * b is one broadcasted
-    # f32 multiply (< 2^16, exact); the pad+add ladder accumulates the
-    # anti-diagonals with every partial sum < 50 * 2^16 < 2^22, exact.
     nd = a.ndim - 1
     rows = []
     for i in range(NLIMBS):
@@ -360,11 +525,111 @@ def fp_mul(
     z = rows[0]
     for r in rows[1:]:
         z = z + r
-    return _finalize(z, 22)
+    return z
 
 
-def fp_sqr(a: jnp.ndarray, *, a_strict: bool = True) -> jnp.ndarray:
-    return fp_mul(a, a, a_strict=a_strict, b_strict=a_strict)
+def _mul_digits_mxu(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """MXU mapping (mode "mxu"): the 50x50 digit product as three dots.
+
+    rep = a @ REP and til = b @ TIL build the (..., 2500) flat outer
+    product as rep * til (digit products <= 2^16, exact); the anti-diagonal
+    one-hot ACC contracts it to (..., 99) columns, each <= 50 * 2^16 < 2^22.
+    All three dots run under the _dot_f32 precision contract, so every
+    operand and accumulator stays f32-exact by construction.
+    """
+    rep = _dot_f32(a, MXU_REP)
+    til = _dot_f32(b, MXU_TIL)
+    return _dot_f32(rep * til, MXU_ACC)
+
+
+def _pack9(a: jnp.ndarray) -> jnp.ndarray:
+    """Strict/semi-strict (..., 50) 8-bit digits -> (..., 45) 9-bit digits
+    (<= 512), value-preserving (mode "mxu9").
+
+    t_i = a_i * 2^{8i mod 9} <= 256 * 2^8 = 2^16; split at base 512 into
+    lo <= 511, hi <= 128; one-hot placement dots scatter lo into 9-bit
+    digit 8i//9 and hi into the next (column sums <= 2, so the accumulated
+    digits are <= 2 * 511 + 2 * 128 < 2^11); a base-512 carry restores
+    <= 512.
+    The two headroom digits the carry appends hold nothing: the value is
+    < 2^401 < 2^405 = (2^9)^45, so slicing back to 45 digits is exact.
+    """
+    t = a * jnp.asarray(_P9_SHIFT)
+    hi = jnp.floor(t * _P9_INV)
+    lo = t - hi * _P9_BASE
+    acc = _dot_f32(lo, _P9_LO) + _dot_f32(hi, _P9_HI)
+    y = _carry_base(acc, 11, PACK9_BITS)
+    return y[..., :PACK9_NLIMBS]
+
+
+def _mul_digits_mxu9(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Re-packed MXU mapping (mode "mxu9"): 45x9-bit digit product.
+
+    Same REP/TIL/ACC shape as _mul_digits_mxu at width 45: flat products
+    <= 512^2 = 2^18, anti-diagonal sums <= 45 * 2^18 < 2^24 (the unique
+    wider packing for which this stays f32-exact — see the PACK9 constants).
+    The base-512 result is carried, then unpacked back to 8-bit digits by
+    the inverse arithmetic scatter (t_j = z_j * 2^{9j mod 8} <= 2^16, split
+    at base 256, injective placement dots, output digits <= 511) and handed
+    to _finalize(·, 9) for the standard carry+fold.
+    """
+    rep = _dot_f32(_pack9(a), MXU9_REP)
+    til = _dot_f32(_pack9(b), MXU9_TIL)
+    z9 = _dot_f32(rep * til, MXU9_ACC)  # (..., 89), digits < 2^24
+    z9 = _carry_base(z9, LOOSE_BITS, PACK9_BITS)  # (..., 91), digits <= 512
+    # value < 2^802 < (2^9)^90: the top carry digit holds nothing
+    z9 = z9[..., : 2 * PACK9_NLIMBS]  # (..., 90)
+    shift, lo_m, hi_m = _unpack9_mats(z9.shape[-1])
+    t = z9 * jnp.asarray(shift)  # <= 512 * 2^7 = 2^16, exact
+    hi = jnp.floor(t * INV_BASE)
+    lo = t - hi * BASE
+    return _dot_f32(lo, lo_m) + _dot_f32(hi, hi_m)  # (..., 102), <= 511
+
+
+@partial(jax.jit, static_argnames=("a_strict", "b_strict", "mode"))
+def _fp_mul_modal(
+    a: jnp.ndarray, b: jnp.ndarray, *, a_strict: bool, b_strict: bool, mode: str
+) -> jnp.ndarray:
+    if not a_strict:
+        a = fp_strict(a)
+    if not b_strict:
+        b = fp_strict(b)
+    if mode == "mxu":
+        return _finalize(_mul_digits_mxu(a, b), 22)
+    if mode == "mxu9":
+        return _finalize(_mul_digits_mxu9(a, b), 9)
+    return _finalize(_mul_digits_ladder(a, b), 22)
+
+
+def fp_mul(
+    a: jnp.ndarray,
+    b: jnp.ndarray,
+    *,
+    a_strict: bool = True,
+    b_strict: bool = True,
+    mode: str | None = None,
+) -> jnp.ndarray:
+    """a * b mod p -> strict (..., 50).
+
+    Inputs must be strict (digits <= 2^8); pass ``a_strict=False`` /
+    ``b_strict=False`` to have them re-normalized here.  The schoolbook
+    digit product runs on the implementation selected by ``mode`` (or, when
+    None, the LODESTAR_TPU_LIMB_MUL env var; unset = "mxu" on TPU backends,
+    "ladder" elsewhere — resolved per call so the static jit key always
+    matches): MXU one-hot dots under the
+    _dot_f32 precision contract, the VPU pad+add ladder, or the 9-bit
+    re-packed contraction.  All modes end in _finalize's RED-table fold
+    back below 2^400.
+    """
+    return _fp_mul_modal(
+        a, b, a_strict=a_strict, b_strict=b_strict, mode=_resolve_limb_mul_mode(mode)
+    )
+
+
+def fp_sqr(
+    a: jnp.ndarray, *, a_strict: bool = True, mode: str | None = None
+) -> jnp.ndarray:
+    return fp_mul(a, a, a_strict=a_strict, b_strict=a_strict, mode=mode)
 
 
 def fp_select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -469,8 +734,8 @@ def _exp_windows(e: int) -> np.ndarray:
     return _EXP_WINDOWS_CACHE[e]
 
 
-@partial(jax.jit, static_argnums=(1,))
-def fp_pow_static(a: jnp.ndarray, e: int) -> jnp.ndarray:
+@partial(jax.jit, static_argnames=("e", "mode"))
+def _fp_pow_static_modal(a: jnp.ndarray, *, e: int, mode: str) -> jnp.ndarray:
     """a^e for a static python-int exponent, via a 4-bit-windowed
     square-and-multiply lax.scan.
 
@@ -493,18 +758,24 @@ def fp_pow_static(a: jnp.ndarray, e: int) -> jnp.ndarray:
     one = jnp.broadcast_to(jnp.asarray(ONE), a.shape).astype(DTYPE)
     powers = [one, a]
     for k in range(2, 16):
-        powers.append(fp_mul(powers[k // 2], powers[k - k // 2]))
+        powers.append(fp_mul(powers[k // 2], powers[k - k // 2], mode=mode))
     table = jnp.stack(powers)  # (16, ..., 50)
 
     def body(r, w):
-        r = fp_sqr(fp_sqr(fp_sqr(fp_sqr(r))))  # r^16
-        r = fp_mul(r, jnp.take(table, w, axis=0))
+        r = fp_sqr(fp_sqr(fp_sqr(fp_sqr(r, mode=mode), mode=mode), mode=mode), mode=mode)
+        r = fp_mul(r, jnp.take(table, w, axis=0), mode=mode)
         return r, None
 
     out, _ = lax.scan(body, one, windows)
     return out
 
 
-def fp_inv(a: jnp.ndarray) -> jnp.ndarray:
+def fp_pow_static(a: jnp.ndarray, e: int, *, mode: str | None = None) -> jnp.ndarray:
+    """See _fp_pow_static_modal; the multiply mode (LODESTAR_TPU_LIMB_MUL)
+    is resolved per call and baked into the jit cache key."""
+    return _fp_pow_static_modal(a, e=e, mode=_resolve_limb_mul_mode(mode))
+
+
+def fp_inv(a: jnp.ndarray, *, mode: str | None = None) -> jnp.ndarray:
     """Multiplicative inverse via Fermat (a^(p-2)); a=0 -> 0."""
-    return fp_pow_static(a, P_INT - 2)
+    return fp_pow_static(a, P_INT - 2, mode=mode)

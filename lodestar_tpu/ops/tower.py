@@ -13,7 +13,11 @@ Two design rules make this TPU-shaped:
    over one flat lane axis — one Fq12 multiply issues one 54-lane limb
    multiply rather than 54 small ones.  This keeps the XLA graph small and
    the TPU vector units wide.  It replaces the reference's blst assembly
-   tower (SURVEY.md §2.9) rather than translating it.
+   tower (SURVEY.md §2.9) rather than translating it.  Since the MXU
+   rewrite, the stacked ``fp_mul`` itself lowers to batched one-hot
+   ``dot_general`` contractions under limbs._dot_f32's precision contract
+   (LODESTAR_TPU_LIMB_MUL selects the VPU ladder fallback), so the lane
+   axis here becomes the MXU batch dimension.
 
 2. FLAT LANE PLUMBING (round-3): Fq12 values are rank-(n+3) flat
    (..., 6, 2, 50) arrays, and every tower op builds its lane batches with

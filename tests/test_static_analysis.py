@@ -253,6 +253,64 @@ class TestJaxprAuditor:
         )
         assert any(v.rule == "jaxpr-unstable-cache-key" for v in vs)
 
+    def test_mxu_precision_fixture_exact_lines(self):
+        """jaxpr-mxu-precision fires on every contract-dropping dot in the
+        fixture, EXACTLY on the ``# VIOLATION`` lines, and stays quiet on
+        the full-contract program."""
+        import jax
+        import jax.numpy as jnp
+
+        from analysis_fixtures import bad_mxu_precision as fx
+
+        marked = set(violation_lines(fixture_source("bad_mxu_precision.py")))
+        fired = set()
+        for fn, shapes in fx.BAD_PROGRAMS:
+            jx = jax.make_jaxpr(fn)(
+                *[jax.ShapeDtypeStruct(s, jnp.float32) for s in shapes]
+            )
+            vs = jaxpr_audit._check_mxu_precision(
+                fn.__name__, 4, jaxpr_audit.extract_artifacts(jx)
+            )
+            assert vs, f"{fn.__name__} must trip jaxpr-mxu-precision"
+            for v in vs:
+                assert v.rule == "jaxpr-mxu-precision"
+                assert v.path.endswith("bad_mxu_precision.py"), v.path
+                fired.add(v.line)
+        assert fired == marked, (sorted(fired), sorted(marked))
+        for fn, shapes in fx.GOOD_PROGRAMS:
+            jx = jax.make_jaxpr(fn)(
+                *[jax.ShapeDtypeStruct(s, jnp.float32) for s in shapes]
+            )
+            vs = jaxpr_audit._check_mxu_precision(
+                fn.__name__, 4, jaxpr_audit.extract_artifacts(jx)
+            )
+            assert vs == [], format_report(vs)
+
+    def test_mxu_precision_live_limb_paths(self):
+        """Every LODESTAR_TPU_LIMB_MUL mode traces to a graph whose dots
+        (if any) all carry the full precision contract — proven on fresh
+        tiny traces, not the artifact cache."""
+        import jax
+        import jax.numpy as jnp
+
+        from lodestar_tpu.ops import limbs as fl
+
+        # the census dedupes call sites: every dot in the mxu/mxu9 graphs
+        # routes through the single limbs._dot_f32 source line
+        for mode, expect_dots in (("ladder", 0), ("mxu", 1), ("mxu9", 1)):
+            jx = jax.make_jaxpr(
+                lambda a, b, m=mode: fl.fp_mul(a, b, mode=m)
+            )(
+                jax.ShapeDtypeStruct((4, fl.NLIMBS), jnp.float32),
+                jax.ShapeDtypeStruct((4, fl.NLIMBS), jnp.float32),
+            )
+            art = jaxpr_audit.extract_artifacts(jx)
+            vs = jaxpr_audit._check_mxu_precision(f"fp_mul@{mode}", 4, art)
+            assert vs == [], format_report(vs)
+            assert len(art["dot_generals"]) == expect_dots, (
+                mode, art["dot_generals"],
+            )
+
 
 # ---------------------------------------------------------------------------
 # 4. mutation tests: each historical regression class turns the suite red
@@ -289,6 +347,66 @@ class TestMutations:
 
         monkeypatch.setattr(fused_core, "lstack", stack_always)
         assert trace_lstack(), "mutated lstack must trip the concat rule"
+
+    def test_mxu_precision_drop_mutation(self, monkeypatch):
+        """Stripping the precision attribute from limbs._dot_f32 (the
+        pre-contract dot shape) trips jaxpr-mxu-precision on a fresh
+        fp_mul trace; the live helper is clean on the same trace."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from lodestar_tpu.ops import limbs as fl
+
+        def trace_mxu_mul():
+            # trace the un-jitted multiply core: the jit wrapper's trace
+            # cache would replay the pre-mutation graph regardless of the
+            # patched helper, and clearing global jax caches would force
+            # recompiles across the rest of the suite
+            jx = jax.make_jaxpr(
+                lambda a, b: fl._finalize(fl._mul_digits_mxu(a, b), 22)
+            )(
+                jax.ShapeDtypeStruct((4, fl.NLIMBS), jnp.float32),
+                jax.ShapeDtypeStruct((4, fl.NLIMBS), jnp.float32),
+            )
+            return jaxpr_audit._check_mxu_precision(
+                "fp_mul@mxu", 4, jaxpr_audit.extract_artifacts(jx)
+            )
+
+        assert trace_mxu_mul() == [], "live _dot_f32 must carry the contract"
+
+        def naked_dot(x, w):
+            return lax.dot_general(
+                x, jnp.asarray(w), (((x.ndim - 1,), (0,)), ((), ()))
+            )
+
+        monkeypatch.setattr(fl, "_dot_f32", naked_dot)
+        assert trace_mxu_mul(), "contract-less dot must trip the rule"
+
+    def test_limb_interval_vacuous_dot_mutation(self, monkeypatch):
+        """A vacuous proof on the MXU path turns the suite red: making the
+        analyzer's const-aware dot rule return TOP drops fp_mul@mxu
+        coverage below the pinned 1.0 (the anti-vacuity gate in
+        tests/test_compile_cost.py) — the proof is load-bearing, not
+        incidentally green."""
+        from lodestar_tpu.analysis import limb_interval as li
+
+        entry = next(
+            e for e in li.limb_entries() if e.name == "fp_mul@mxu"
+        )
+        rep = li.analyze_callable(entry.fn, entry.in_shapes, entry.in_intervals)
+        assert rep.coverage == 1.0 and rep.findings == []
+
+        monkeypatch.setattr(
+            li._Analyzer, "_dot_interval", lambda self, eqn, ins: li.TOP
+        )
+        mutated = li.analyze_callable(
+            entry.fn, entry.in_shapes, entry.in_intervals
+        )
+        assert mutated.coverage < 1.0, (
+            "TOP dot bounds must be visible as lost coverage — a vacuous "
+            "MXU proof would otherwise pass silently"
+        )
 
     def test_bls_pool_bare_result_mutation(self):
         """Injecting a bare .result() into the live _flush source (the

@@ -68,6 +68,7 @@ def _print_rules() -> None:
         ("jaxpr-f64-leak", "64-bit dtype outside the f32 limb format"),
         ("jaxpr-host-callback", "host callback inside a hot-path program"),
         ("jaxpr-unstable-cache-key", "captured scalar / bucket-dependent constants"),
+        ("jaxpr-mxu-precision", "dot_general without f32 preferred type + HIGHEST"),
         ("jaxpr-limb-overflow", "limb digit magnitude proven past the f32-exact 2^24"),
         ("compile-unstubbed-test", "tier-1 test reaches a real verifier materialization"),
         ("compile-duplicate-program", "two tier-1 modules materialize the same program key"),
