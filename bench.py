@@ -53,6 +53,19 @@ os.environ.setdefault(salvage.BASE_DIR_ENV, salvage.base_dir())
 BATCH = int(os.environ.get("BENCH_BATCH", "128"))
 
 
+class StageSkip(Exception):
+    """A stage declining to run on THIS host (wrong backend, too few
+    cores/devices, cold-compile budget exhaustion).  Distinct from a
+    failure: the driver records the reason under
+    ``extras.<stage>.skip_reason`` instead of an error string, so a
+    published artifact says WHY a number is missing — a silent None and
+    a crash repr both read as "something broke" three rounds later."""
+
+
+# fn_name -> reason; filled by _stage in the parent when a child skips
+_STAGE_SKIPS: dict = {}
+
+
 def build_batch(n: int):
     from lodestar_tpu.ops.batch_verify import example_inputs
 
@@ -86,6 +99,11 @@ def bench_pallas_fused(args, repeats: int = 3):
 
     from lodestar_tpu.ops.fused_verify import verify_signature_sets_fused
 
+    if jax.default_backend() != "tpu":
+        raise StageSkip(
+            "Mosaic kernels need a TPU backend; interpret-mode rates are "
+            "not comparable numbers"
+        )
     fn = jax.jit(lambda *a: verify_signature_sets_fused(*a, interpret=False))
     out = fn(*args)
     assert bool(out), "benchmark batch failed to verify (pallas fused)"
@@ -106,6 +124,12 @@ def bench_pallas_split(args, repeats: int = 3):
 
     from lodestar_tpu.crypto.bls.tpu_verifier import TpuBlsVerifier
     from lodestar_tpu.ops.fused_verify import miller_product_fused
+
+    if jax.default_backend() != "tpu":
+        raise StageSkip(
+            "Mosaic kernels need a TPU backend; interpret-mode rates are "
+            "not comparable numbers"
+        )
 
     def kernel(*a):
         f, ok = miller_product_fused(*a, interpret=False)
@@ -281,13 +305,19 @@ def bench_limb_mul(buckets=(4, 128), iters: int = 20):
 
 def bench_small_bucket(n: int = 16, budget_s: float = 120.0):
     """Dispatch latency for the small gossip bucket (VERDICT r3 weak 10:
-    the latency distribution the node actually feels).  Soft-skipped when
-    the program is not already in the compile cache."""
+    the latency distribution the node actually feels).  Skips (with the
+    reason recorded) when the program is not already in the compile
+    cache."""
     import jax
 
     from lodestar_tpu.crypto.bls.tpu_verifier import TpuBlsVerifier
     from lodestar_tpu.ops.fused_verify import miller_product_fused
 
+    if jax.default_backend() != "tpu":
+        raise StageSkip(
+            "Mosaic kernels need a TPU backend; interpret-mode rates are "
+            "not comparable numbers"
+        )
     args = build_batch(n)
 
     def kernel(*a):
@@ -300,7 +330,10 @@ def bench_small_bucket(n: int = 16, budget_s: float = 120.0):
     f, ok = fn(*args)
     f.block_until_ready()
     if time.perf_counter() - t0 > budget_s:
-        return None  # cold compile; don't risk the driver's wall clock
+        raise StageSkip(  # don't risk the driver's wall clock
+            f"cold compile ate the {budget_s:.0f}s budget "
+            "(bucket-16 program not in the persistent cache)"
+        )
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
@@ -508,9 +541,20 @@ def bench_multichip(time_budget_s: float = 540.0):
     the same workload on 1 device (SURVEY §2.10 ICI data-parallel, rebuilt
     as batch-level scheduling).  Publishes the north-star
     ``sets_per_sec_per_chip`` plus ``scaling_efficiency`` =
-    rate(N)/(N * rate(1)).  Soft-skips (None) with < 2 devices or when the
-    per-device warmup would blow the stage budget."""
+    rate(N)/(N * rate(1)).  Skips (reason recorded in
+    ``extras.multichip.skip_reason``) on single-core hosts, with < 2
+    devices, or when the per-device warmup would blow the stage budget."""
     import time as _t
+
+    # fail FAST, before jax init: on a single-core host the 8 forced
+    # virtual devices all time-share one core, the per-device warmup
+    # compiles never finish inside the 600s stage bound, and the driver
+    # burns the full timeout killing a wedged child (the PR 18 rc=124)
+    if (os.cpu_count() or 1) < 2:
+        raise StageSkip(
+            "single-core host: forced virtual devices oversubscribe one "
+            "core and the per-device warmup blows the stage budget"
+        )
 
     import jax
 
@@ -521,7 +565,7 @@ def bench_multichip(time_budget_s: float = 540.0):
 
     devices = jax.devices()
     if len(devices) < 2:
-        return None
+        raise StageSkip(f"{len(devices)} JAX device(s): scaling needs >= 2")
     backend = jax.default_backend()
     # CPU virtual devices share the host's cores — bucket 4 keeps the smoke
     # test affordable; real TPUs measure the production block-sized bucket
@@ -567,7 +611,10 @@ def bench_multichip(time_budget_s: float = 540.0):
     single = TpuBlsVerifier(buckets=(bucket,))
     rate1 = throughput(single)
     if _t.perf_counter() - t_start > time_budget_s:
-        return None  # cold compile ate the budget; don't risk the wall clock
+        raise StageSkip(  # don't risk the driver's wall clock
+            f"cold compile ate the {time_budget_s:.0f}s budget before the "
+            "multi-device run"
+        )
     multi = TpuBlsVerifier(buckets=(bucket,), devices=devices[:n_dev])
     rate_n = throughput(multi)
     placed = {
@@ -1040,6 +1087,8 @@ def _stage_child(q, fn_name, args):
     try:
         fn = globals()[fn_name]
         q.put(("ok", fn(*args)))
+    except StageSkip as e:
+        q.put(("skip", str(e)))
     except BaseException as e:  # noqa: BLE001 - includes SystemExit from jax
         try:
             q.put(("err", f"{type(e).__name__}: {e}"))
@@ -1097,6 +1146,10 @@ def _stage(fn_name, args=(), timeout_s=600.0, retries=1):
         p.join(30)
         if status == "ok":
             return payload, None
+        if status == "skip":
+            _STAGE_SKIPS[fn_name] = payload
+            print(f"{fn_name}: skipped — {payload}", file=sys.stderr)
+            return None, None
         last_err = payload
         print(f"{fn_name} attempt {attempt}: {payload}", file=sys.stderr)
         if payload.startswith("AssertionError"):
@@ -1171,6 +1224,14 @@ def main() -> None:
         os.environ.pop("XLA_FLAGS", None)
     if err:
         errors["multichip"] = err
+
+    # structured skips: a stage that declined (StageSkip) publishes WHY
+    # under its own extras entry — extras.<stage>.skip_reason
+    def _skip_extra(fn_name):
+        reason = _STAGE_SKIPS.get(fn_name)
+        return {"skip_reason": reason} if reason else None
+
+    multichip = multichip or _skip_extra("bench_multichip")
     scale, err = _stage("bench_scale_250k", (), 420)
     if err:
         errors["scale_250k"] = err
@@ -1244,6 +1305,9 @@ def main() -> None:
                     "dispatch_ms_fused": round(fused_dt * 1e3, 2) if fused_dt else None,
                     "sets_per_s_split": round(split_rate, 2) if split_rate else None,
                     "dispatch_ms_bucket16": round(small_dt * 1e3, 2) if small_dt else None,
+                    "pallas_fused": _skip_extra("bench_pallas_fused"),
+                    "pallas_split": _skip_extra("bench_pallas_split"),
+                    "bucket16": _skip_extra("bench_small_bucket"),
                     "cpu_native_sets_per_s": round(cpu_native, 1) if cpu_native else None,
                     "cpu_oracle_sets_per_s": round(cpu_oracle, 3),
                     "baseline_kind": "fastbls-c" if cpu_native else "python-oracle",

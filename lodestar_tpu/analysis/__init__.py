@@ -12,7 +12,7 @@ no existing check could see before runtime:
 - Shared mutable state (verifier counters, the ``PointCache`` LRU)
   mutated from ``asyncio.to_thread`` workers introduced in PR 3.
 
-Four layers, one report format (``report.Violation``):
+Five layers, one report format (``report.Violation``):
 
 - ``jaxpr_audit``  — abstract-traces every public fused program in
   ``lodestar_tpu/ops/`` (``jax.make_jaxpr`` only: no backend compile, no
@@ -32,8 +32,13 @@ Four layers, one report format (``report.Violation``):
   the runtime ledgers and the conftest compile-guard whitelist (tier-1
   died rc=124 three times in one session with ZERO failing tests; the
   compile budget is now a statically checked property).
+- ``pallas_audit`` — walks every ``pallas_call`` in the traced entry
+  jaxprs plus the kernel library (pallas_tower / pallas_fuse /
+  pallas_ring) and proves DMA/semaphore balance, ref-race freedom,
+  ring-neighbor topology, and Mosaic block tiling before any TPU cycle
+  — the contract layer for ROADMAP item 3's remote-DMA pairing v2.
 
-``tools/lint.py`` drives all four and exits nonzero on violations;
+``tools/lint.py`` drives all five and exits nonzero on violations;
 ``bench.py`` runs the same suite as a pre-flight stage;
 ``tools/tier1_budget.py --enforce`` combines the compile-cost layer with
 the wall-clock margin gate.  The rule catalogue (with the incident
@@ -53,6 +58,7 @@ def run_all(
     with_lock_audit: bool = True,
     trace_cache: bool = True,
     with_compile_cost: bool = True,
+    with_pallas: bool = True,
 ) -> List[Violation]:
     """Every analysis layer, one violation list — the entry point
     tools/lint.py, bench.py's pre-flight stage, and the tier-1 tests share
@@ -81,4 +87,11 @@ def run_all(
         from .limb_interval import audit_limb_overflow
 
         violations += audit_limb_overflow(repo=repo)
+    if with_pallas:
+        # the dispatch-entry graphs are swept inside audit_all via the
+        # "pallas" artifact field; this adds the kernel-library entries
+        # (pallas_tower / pallas_fuse / pallas_ring) audit_all can't reach
+        from .pallas_audit import audit_all_pallas
+
+        violations += audit_all_pallas(use_cache=trace_cache)
     return violations

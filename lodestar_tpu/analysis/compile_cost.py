@@ -154,6 +154,7 @@ class FuncScan:
     drives: List[Tuple[str, int, str]] = field(default_factory=list)  # var, line, method
     jit_sites: List[Tuple[int, Optional[str]]] = field(default_factory=list)
     lib_jit_sites: List[Tuple[int, str]] = field(default_factory=list)
+    pallas_sites: List[Tuple[int, str]] = field(default_factory=list)
     trace_sites: List[Tuple[int, Optional[str]]] = field(default_factory=list)
     calls: List[Tuple[int, str]] = field(default_factory=list)  # resolved dotted refs
     returns_vars: Set[str] = field(default_factory=set)
@@ -161,7 +162,7 @@ class FuncScan:
     # resolved in phase 2:
     materializes: bool = False
     mat_sites: List[Tuple[int, str, Tuple[str, ...]]] = field(default_factory=list)
-    # (line, kind, program keys) with kind in jit|verifier|helper|fixture
+    # (line, kind, program keys) with kind in jit|verifier|helper|fixture|pallas
     returns_real_verifier: bool = False
     is_stub_factory: bool = False
     real_keys: Set[str] = field(default_factory=set)
@@ -241,10 +242,12 @@ class _BodyScanner(ast.NodeVisitor):
     raw materialization facts."""
 
     def __init__(self, mod: ModuleScan, fn: FuncScan,
-                 jitted_lib: Dict[str, Set[str]]):
+                 jitted_lib: Dict[str, Set[str]],
+                 pallas_lib: Optional[Dict[str, Set[str]]] = None):
         self.mod = mod
         self.fn = fn
         self.jitted_lib = jitted_lib
+        self.pallas_lib = pallas_lib or {}
         self.alias_vars: Dict[str, str] = {}  # ex -> v (executor aliases)
         self.local_wrappers: Dict[str, Optional[str]] = {}
         self.aliases: Dict[str, str] = dict(mod.aliases)  # + in-body imports
@@ -429,7 +432,12 @@ class _BodyScanner(ast.NodeVisitor):
             )
         elif resolved is not None:
             head = resolved.rsplit(".", 1)
-            if len(head) == 2 and head[1] in self.jitted_lib.get(head[0], ()):
+            if resolved.rsplit(".", 1)[-1] == "pallas_call" or (
+                len(head) == 2
+                and head[1] in self.pallas_lib.get(head[0], ())
+            ):
+                self.fn.pallas_sites.append((node.lineno, resolved))
+            elif len(head) == 2 and head[1] in self.jitted_lib.get(head[0], ()):
                 self.fn.lib_jit_sites.append((node.lineno, resolved))
             elif isinstance(node.func, ast.Name):
                 name = node.func.id
@@ -474,7 +482,9 @@ def _annotate_parents(tree: ast.AST) -> None:
 
 
 def scan_module(path: str, repo: str,
-                jitted_lib: Dict[str, Set[str]]) -> Optional[ModuleScan]:
+                jitted_lib: Dict[str, Set[str]],
+                pallas_lib: Optional[Dict[str, Set[str]]] = None,
+                ) -> Optional[ModuleScan]:
     rel = os.path.relpath(path, repo)
     try:
         with open(path, encoding="utf-8") as f:
@@ -535,7 +545,7 @@ def scan_module(path: str, repo: str,
             skipif=any(".mark.skipif" in d for d in decos),
             params=tuple(a.arg for a in node.args.args if a.arg != "self"),
         )
-        scanner = _BodyScanner(mod, fn, jitted_lib)
+        scanner = _BodyScanner(mod, fn, jitted_lib, pallas_lib)
         for stmt in node.body:
             scanner.visit(stmt)
         mod.funcs[qual] = fn
@@ -601,6 +611,58 @@ def jitted_library_functions(repo: str) -> Dict[str, Set[str]]:
     return out
 
 
+def pallas_library_functions(repo: str) -> Dict[str, Set[str]]:
+    """Module dotted path -> top-level functions that reach a
+    ``pl.pallas_call`` (directly, or through a same-module callee).
+    Calling one from tier-1 materializes a Mosaic/interpret program
+    exactly like a jit site — interpret=True still XLA-compiles the
+    discharged kernel on CPU."""
+    out: Dict[str, Set[str]] = {}
+    lib = os.path.join(repo, "lodestar_tpu")
+    for dirpath, dirnames, filenames in os.walk(lib):
+        dirnames[:] = [d for d in dirnames if not d.startswith((".", "__"))]
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, repo)
+            dotted = rel[:-3].replace(os.sep, ".")
+            try:
+                with open(path, encoding="utf-8") as f:
+                    tree = ast.parse(f.read())
+            except (OSError, SyntaxError):
+                continue
+            direct: Set[str] = set()
+            callees: Dict[str, Set[str]] = {}
+            for node in tree.body:
+                if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                called: Set[str] = set()
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    d = _dotted(sub.func)
+                    if not d:
+                        continue
+                    if d.rsplit(".", 1)[-1] == "pallas_call":
+                        direct.add(node.name)
+                    elif "." not in d:
+                        called.add(d)
+                callees[node.name] = called
+            # same-module propagation: fq12_combine_ring_dma ->
+            # ring_all_gather -> pallas_call
+            changed = True
+            while changed:
+                changed = False
+                for name, refs in callees.items():
+                    if name not in direct and refs & direct:
+                        direct.add(name)
+                        changed = True
+            if direct:
+                out[dotted] = direct
+    return out
+
+
 # ---------------------------------------------------------------------------
 # phase 2: cross-module resolution (import-graph fixpoint)
 # ---------------------------------------------------------------------------
@@ -643,6 +705,9 @@ def _resolve_modules(mods: Dict[str, ModuleScan]) -> None:
                 fn.mat_sites.append(
                     (line, "jit", (f"jit:{target}",) if target else ())
                 )
+            for line, target in fn.pallas_sites:
+                fn.materializes = True
+                fn.mat_sites.append((line, "pallas", (f"pallas:{target}",)))
 
     # helper factories: v = make_real(); v.verify(...)
     for mod in mods.values():
@@ -799,6 +864,7 @@ def build_map(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         )
     jitted = jitted_library_functions(repo)
+    pallas = pallas_library_functions(repo)
     if test_paths is None:
         tdir = os.path.join(repo, "tests")
         test_paths = sorted(
@@ -815,7 +881,7 @@ def build_map(
             )
     mods: Dict[str, ModuleScan] = {}
     for path in test_paths:
-        scan = scan_module(path, repo, jitted)
+        scan = scan_module(path, repo, jitted, pallas)
         if scan is not None:
             mods[scan.dotted] = scan
     _resolve_modules(mods)
@@ -851,7 +917,8 @@ def audit_compile_cost(
             if fn.slow or fn.skipif or _whitelisted(nodeid, report.whitelist):
                 continue
             verifier_sites = [
-                s for s in fn.mat_sites if s[1] in ("verifier", "fixture", "helper")
+                s for s in fn.mat_sites
+                if s[1] in ("verifier", "fixture", "helper", "pallas")
             ]
             jit_only = [s for s in fn.mat_sites if s[1] == "jit"]
             for line, kind, keys in verifier_sites:

@@ -265,7 +265,7 @@ def all_eqns(closed_jaxpr) -> List:
 # schema tag folded into the fingerprint alongside a hash of this module's
 # own source (so editing the trace inputs or extraction logic invalidates
 # the cache automatically, no manual bump required)
-_CACHE_VERSION = 3  # v3: dot_general precision-contract census
+_CACHE_VERSION = 4  # v4: pallas_call kernel records (pallas_audit layer)
 
 
 def _eqn_site(eqn) -> Tuple[str, int]:
@@ -429,6 +429,11 @@ def extract_artifacts(closed_jaxpr) -> dict:
         ],
         "sharded": _sharded_stats(closed_jaxpr),
     }
+    # layer-5 sweep: every pallas_call reachable from this entry gets a
+    # kernel record (lazy import — pallas_audit imports this module)
+    from . import pallas_audit
+
+    art["pallas"] = pallas_audit.extract_pallas_records(closed_jaxpr)
     # canonicalize through JSON so cold-extracted and cache-loaded
     # artifacts compare equal (tuples -> lists, np ints -> ints)
     return json.loads(json.dumps(art))
@@ -449,8 +454,12 @@ def _ops_fingerprint() -> str:
     from ..ops.limbs import limb_mul_mode
 
     h.update(f"limb_mul={limb_mul_mode()}:".encode())
-    with open(os.path.abspath(__file__).replace(".pyc", ".py"), "rb") as f:
-        h.update(f.read())
+    here = os.path.abspath(__file__).replace(".pyc", ".py")
+    # pallas_audit's extraction logic feeds the "pallas" artifact field
+    # and the pallas:<entry> records — its edits must invalidate too
+    for mod in (here, os.path.join(os.path.dirname(here), "pallas_audit.py")):
+        with open(mod, "rb") as f:
+            h.update(f.read())
     ops_dir = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "ops")
     for dirpath, dirnames, filenames in os.walk(ops_dir):
         dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
@@ -733,6 +742,10 @@ def audit_entry(
         out.extend(_check_wide_dtypes(name, b, arts[b]))
         out.extend(_check_callbacks(name, b, arts[b]))
         out.extend(_check_mxu_precision(name, b, arts[b]))
+        from . import pallas_audit
+
+        out.extend(pallas_audit.check_pallas_records(
+            f"{name}@{b}", arts[b].get("pallas")))
         if meta.get("sharded"):
             out.extend(check_sharded_rules(name, b, arts[b]))
     out.extend(_check_cache_keys(name, buckets, arts))

@@ -1,0 +1,41 @@
+"""Known-bad fixture for ``pallas-block-misaligned``: gridded block
+shapes Mosaic rejects at compile time (the BENCH_r05 rc=124 class, one
+layer down from the lax-level narrow-concat rule).  One call splits the
+trailing (sublane, lane) dims into sub-tile pieces; the other picks a
+block that does not divide the operand shape, leaving ragged edge
+blocks.  Each ``pallas_call`` invocation sits on a single marked line —
+the rule anchors violations at the call site."""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def _shape(x):
+    return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+
+def _subtile(x):
+    # (5, 100) blocks: 5 < the f32 sublane tile 8, 100 % 128 != 0
+    spec = pl.BlockSpec((5, 100), lambda i: (0, i))
+    return pl.pallas_call(_copy_kernel, out_shape=_shape(x), grid=(3,), in_specs=[spec], out_specs=spec, interpret=True)(x)  # VIOLATION pallas-block-misaligned
+
+
+def _ragged(x):
+    # 7 does not divide 20: ragged edge blocks on the sublane dim
+    spec = pl.BlockSpec((7, 128), lambda i: (0, i))
+    return pl.pallas_call(_copy_kernel, out_shape=_shape(x), grid=(2,), in_specs=[spec], out_specs=spec, interpret=True)(x)  # VIOLATION pallas-block-misaligned
+
+
+def build():
+    def fn(a, b):
+        return _subtile(a), _ragged(b)
+
+    return fn, (
+        jax.ShapeDtypeStruct((20, 300), jnp.float32),
+        jax.ShapeDtypeStruct((20, 256), jnp.float32),
+    )

@@ -327,6 +327,82 @@ class TestMutations:
         assert _audit(tmp_path, [p]) == []
 
 
+class TestPallasMaterialization:
+    """A ``pallas_call`` is a program materialization exactly like a jit
+    site (interpret=True still XLA-compiles the discharged kernel on
+    CPU): an unwhitelisted tier-1 test reaching one must fire
+    compile-unstubbed-test."""
+
+    def test_library_scan_maps_the_kernel_modules(self):
+        from lodestar_tpu.analysis.compile_cost import pallas_library_functions
+
+        lib = pallas_library_functions(REPO)
+        # transitive within the module: ring_combine_fn ->
+        # fq12_combine_ring_dma -> ring_all_gather -> pl.pallas_call
+        assert {
+            "ring_all_gather", "fq12_combine_ring_dma", "ring_combine_fn"
+        } <= lib["lodestar_tpu.ops.pallas_ring"]
+        assert "fq2_mul" in lib["lodestar_tpu.ops.pallas_tower"]
+        assert "pallas_fuse" in lib["lodestar_tpu.ops.pallas_fuse"]
+
+    def test_direct_pallas_call_fires(self, tmp_path):
+        p = _scratch(tmp_path, "test_scratch_a.py", """
+            from jax.experimental import pallas as pl
+
+            def test_drives_pallas_kernel():
+                out = pl.pallas_call(lambda x_ref, o_ref: None,
+                                     out_shape=None)(None)
+        """)
+        vs = _audit(tmp_path, [p])
+        assert _rules(vs) == [RULE_UNSTUBBED]
+        assert "pallas:" in vs[0].message
+
+    def test_pallas_library_helper_fires(self, tmp_path):
+        # repo=REPO so the library scan sees ops/pallas_ring.py; empty
+        # whitelist so only the scratch module's own sites count
+        p = _scratch(tmp_path, "test_scratch_a.py", """
+            import lodestar_tpu.ops.pallas_ring as pr
+            from lodestar_tpu.ops.sharded_verify import make_mesh
+
+            def test_drives_ring_combine():
+                fn = pr.ring_combine_fn(make_mesh(n_devices=2),
+                                        interpret=True)
+        """)
+        vs = audit_compile_cost(
+            repo=REPO, test_paths=[p], whitelist=[], use_ledger=False
+        )
+        unstubbed = [v for v in vs if v.rule == RULE_UNSTUBBED]
+        assert len(unstubbed) == 1, _rules(vs)
+        assert (
+            "pallas:lodestar_tpu.ops.pallas_ring.ring_combine_fn"
+            in unstubbed[0].message
+        )
+
+    def test_slow_marked_pallas_is_clean(self, tmp_path):
+        p = _scratch(tmp_path, "test_scratch_a.py", """
+            import pytest
+            from jax.experimental import pallas as pl
+
+            @pytest.mark.slow
+            def test_drives_pallas_kernel():
+                out = pl.pallas_call(lambda x_ref, o_ref: None,
+                                     out_shape=None)(None)
+        """)
+        assert _audit(tmp_path, [p]) == []
+
+    def test_whitelisted_pallas_is_clean(self, tmp_path):
+        p = _scratch(tmp_path, "test_scratch_a.py", """
+            from jax.experimental import pallas as pl
+
+            def test_drives_pallas_kernel():
+                out = pl.pallas_call(lambda x_ref, o_ref: None,
+                                     out_shape=None)(None)
+        """)
+        assert _audit(
+            tmp_path, [p], [("tests/test_scratch_a.py::*", 1)]
+        ) == []
+
+
 # ---------------------------------------------------------------------------
 # runtime-ledger cross-check (and the partial-ring bugfix interplay)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,224 @@
+"""Pallas kernel verifier (analysis/pallas_audit.py): the fifth
+static-analysis layer.
+
+Three layers of evidence, mirroring tests/test_static_analysis.py and
+tests/test_compile_cost.py:
+
+- live tree: every kernel-library entry (pallas_tower / pallas_fuse /
+  pallas_ring) audits CLEAN, and the rule catalogue is published by
+  ``tools/lint.py --rules``;
+- fixtures: each rule fires EXACTLY on the ``# VIOLATION`` lines of its
+  known-bad module, and only its own rule — an analyzer that never
+  fires is indistinguishable from one that works;
+- mutations: breaking a REAL kernel (drop a wait, race a ref, unwrap
+  the ring neighbor, grid a ragged block) turns the auditor red, and
+  restoring it turns it green again.
+
+Everything here is make_jaxpr-or-less: no backend compiles, no
+whitelist entry needed.
+"""
+
+import subprocess
+import sys
+import os
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from lodestar_tpu.analysis import pallas_audit as pa
+from lodestar_tpu.analysis.pallas_audit import (
+    RULE_DMA,
+    RULE_RACE,
+    RULE_RING,
+    RULE_TILE,
+    audit_all_pallas,
+    check_pallas_records,
+    extract_pallas_records,
+    pallas_entry_points,
+)
+from lodestar_tpu.ops import pallas_ring as pr
+from lodestar_tpu.ops.sharded_verify import MESH_AXIS
+
+from analysis_fixtures import fixture_source, violation_lines
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(violations):
+    return sorted({v.rule for v in violations})
+
+
+def _check_fixture(name, expected_rule):
+    """Trace a known-bad fixture, audit it, and pin the violations to
+    exactly the marked lines with exactly the expected rule."""
+    mod = __import__(f"analysis_fixtures.{name[:-3]}", fromlist=["build"])
+    fn, args = mod.build()
+    jx = jax.make_jaxpr(fn)(*args)
+    vs = check_pallas_records(name, extract_pallas_records(jx))
+    assert vs, f"{name}: auditor stayed green on the known-bad fixture"
+    assert _rules(vs) == [expected_rule], _rules(vs)
+    assert sorted({v.line for v in vs}) == violation_lines(
+        fixture_source(name)
+    ), [(v.line, v.message) for v in vs]
+    for v in vs:
+        assert v.path.endswith(name), v.path
+
+
+# ---------------------------------------------------------------------------
+# live tree
+# ---------------------------------------------------------------------------
+
+
+class TestLiveTree:
+    def test_kernel_library_zero_violations(self):
+        vs = audit_all_pallas(use_cache=True)
+        assert vs == [], "\n".join(f"{v.rule}: {v.message}" for v in vs)
+
+    def test_entry_points_cover_the_kernel_library(self):
+        names = set(pallas_entry_points())
+        assert {
+            "pallas_tower.fq2_mul", "pallas_tower.fq2_sqr",
+            "pallas_tower.fq6_mul", "pallas_tower.fq12_mul",
+            "pallas_fuse.fq2_mul",
+        } <= names
+        # the ring prototype is audited whenever the mesh is traceable
+        from lodestar_tpu.analysis import jaxpr_audit as ja
+
+        if ja.sharded_audit_available():
+            assert "pallas_ring.ring_combine" in names
+
+    def test_lint_cli_publishes_the_rule_catalogue(self):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+             "--rules"],
+            capture_output=True, text=True, check=True, cwd=REPO,
+        ).stdout
+        for rule in (RULE_DMA, RULE_RACE, RULE_RING, RULE_TILE):
+            assert rule in out, rule
+
+
+# ---------------------------------------------------------------------------
+# fixtures: exact-line firing, one rule each
+# ---------------------------------------------------------------------------
+
+
+class TestFixtures:
+    def test_dma_unbalanced_fires_on_marked_lines(self):
+        _check_fixture("bad_pallas_dma.py", RULE_DMA)
+
+    def test_ref_race_fires_on_marked_lines(self):
+        _check_fixture("bad_pallas_race.py", RULE_RACE)
+
+    def test_ring_neighbor_fires_on_marked_lines(self):
+        if len(jax.devices()) < 2:
+            pytest.skip("fixture mesh needs 2 devices")
+        _check_fixture("bad_pallas_ring.py", RULE_RING)
+
+    def test_block_misaligned_fires_on_marked_lines(self):
+        _check_fixture("bad_pallas_tiling.py", RULE_TILE)
+
+
+# ---------------------------------------------------------------------------
+# mutations: break a real kernel, watch the auditor turn red
+# ---------------------------------------------------------------------------
+
+
+def _audit_ring():
+    """Fresh (uncached) trace + audit of the real ring-combine entry,
+    through the auditor's own entry table — trace-only, so this module
+    never owns the whitelisted modules' program keys."""
+    meta = pallas_entry_points()["pallas_ring.ring_combine"]
+    jx = jax.make_jaxpr(meta["fn"])(*meta["args"])
+    return check_pallas_records("ring.mutated", extract_pallas_records(jx))
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="ring mesh needs 2 devices")
+class TestMutations:
+    def test_unmutated_ring_is_clean(self):
+        assert _audit_ring() == []
+
+    def test_dropped_wait_fires_dma_rule(self, monkeypatch):
+        def hop_no_wait(out_ref, my_id, step, n, send_sem, recv_sem):
+            slot = step % 2
+            src = pr._chunk_index(my_id, step, n)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=out_ref.at[pl.ds(src, 1)],
+                dst_ref=out_ref.at[pl.ds(src, 1)],
+                send_sem=send_sem.at[slot],
+                recv_sem=recv_sem.at[slot],
+                device_id=pr._right_neighbor(my_id, n),
+                device_id_type=pltpu.DeviceIdType.MESH,
+            )
+            rdma.start()  # never waited: the in-flight DMA leaks
+
+        monkeypatch.setattr(pr, "_hop", hop_no_wait)
+        vs = _audit_ring()
+        assert RULE_DMA in _rules(vs), _rules(vs)
+        # anchored at the mutated hop's start site (this file), not at
+        # some unrelated kernel
+        assert any(v.path.endswith("test_pallas_audit.py") for v in vs), [
+            v.path for v in vs
+        ]
+
+    def test_touching_inflight_slot_fires_race_rule(self, monkeypatch):
+        def racy_kernel(n, in_ref, out_ref, copy_sem, send_sem, recv_sem):
+            my_id = lax.axis_index(MESH_AXIS)
+            cp = pltpu.make_async_copy(
+                in_ref, out_ref.at[pl.ds(my_id, 1)], copy_sem
+            )
+            cp.start()
+            # reads/writes the slot the DMA is still landing in
+            out_ref[0, 0, 0, 0] = out_ref[0, 0, 0, 0] + 1.0
+            cp.wait()
+            for step in range(n - 1):
+                pr._hop(out_ref, my_id, step, n, send_sem, recv_sem)
+
+        monkeypatch.setattr(pr, "_ring_gather_kernel", racy_kernel)
+        vs = _audit_ring()
+        assert RULE_RACE in _rules(vs), _rules(vs)
+
+    def test_unwrapped_neighbor_fires_ring_rule(self, monkeypatch):
+        monkeypatch.setattr(pr, "_right_neighbor", lambda my_id, n: my_id + 1)
+        vs = _audit_ring()
+        assert RULE_RING in _rules(vs), _rules(vs)
+
+    def test_self_send_fires_ring_rule(self, monkeypatch):
+        monkeypatch.setattr(pr, "_right_neighbor", lambda my_id, n: my_id)
+        vs = _audit_ring()
+        assert RULE_RING in _rules(vs), _rules(vs)
+
+
+class TestTilingMutation:
+    def test_ragged_grid_on_real_kernel_fires(self):
+        """Re-wrap the real tower Fq2 kernel with a grid whose batch
+        block (3) does not divide the batch (4)."""
+        import lodestar_tpu.ops.pallas_tower as pt
+
+        red = jnp.asarray(pt.RED)
+        pad = jnp.asarray(pt.SUBPAD)
+
+        def full(arr):
+            return pl.BlockSpec(arr.shape, lambda i: (0,) * arr.ndim)
+
+        def bad_fq2_mul(a, b):
+            spec = pl.BlockSpec((3,) + a.shape[1:], lambda i: (i, 0, 0))
+            return pl.pallas_call(
+                pt._fq2_mul_kernel,
+                out_shape=jax.ShapeDtypeStruct(a.shape, jnp.float32),
+                grid=(2,),
+                in_specs=[spec, spec, full(red), full(pad)],
+                out_specs=spec,
+                interpret=True,
+            )(a, b, red, pad)
+
+        s = jax.ShapeDtypeStruct((4, 2, 50), jnp.float32)
+        jx = jax.make_jaxpr(bad_fq2_mul)(s, s)
+        vs = check_pallas_records(
+            "tower.mutated", extract_pallas_records(jx)
+        )
+        assert _rules(vs) == [RULE_TILE], _rules(vs)

@@ -351,9 +351,12 @@ class TestShardedChaos:
         """Acceptance pin: device.loss during a sharded batch — the
         verdict still resolves (same packed payload requeued onto ONE
         surviving executor), the mesh quarantines, the pool serves."""
+        # backoff long enough that it cannot expire mid-test on a loaded
+        # box (expiry would legitimately route the probe back to the
+        # mesh and break the pool-serves assertion below)
         v = sharded_stub_verifier(n_devices=4, bucket=8,
                                   quarantine_threshold=1,
-                                  quarantine_backoff_s=0.05)
+                                  quarantine_backoff_s=60.0)
         CHAOS.install(
             FaultPlan(seed=11).add(
                 "device.loss", match={"device": "mesh4"}, count=1

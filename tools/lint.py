@@ -19,11 +19,17 @@ Layers (see lodestar_tpu/analysis/ and docs/static_analysis.md):
    are cached under .jax_cache/ keyed by a content hash of ops/, so
    re-runs on an untouched ops/ replay in milliseconds) plus the
    limb-interval overflow proof over the ops/limbs.py contracts.
+5. Pallas kernel verifier: every pallas_call in the traced entries plus
+   the kernel library (pallas_tower / pallas_fuse / pallas_ring) is
+   audited for DMA/semaphore balance, ref races, ring-neighbor
+   topology, and Mosaic block tiling (rules pallas-dma-unbalanced,
+   pallas-ref-race, pallas-ring-neighbor, pallas-block-misaligned) —
+   rides the same artifact cache as layer 4.
 
 Usage:
     python tools/lint.py [--repo PATH] [--json] [--skip-jaxpr]
                          [--skip-lock-audit] [--skip-compile-cost]
-                         [--buckets 4,128] [--rules]
+                         [--skip-pallas] [--buckets 4,128] [--rules]
 
 Exit 0 when clean; exit 1 listing the violations.  tier-1 drives the same
 layers from tests/test_static_analysis.py; bench.py runs this as a
@@ -70,6 +76,10 @@ def _print_rules() -> None:
         ("jaxpr-unstable-cache-key", "captured scalar / bucket-dependent constants"),
         ("jaxpr-mxu-precision", "dot_general without f32 preferred type + HIGHEST"),
         ("jaxpr-limb-overflow", "limb digit magnitude proven past the f32-exact 2^24"),
+        ("pallas-dma-unbalanced", "DMA start/wait semaphore imbalance on some control path"),
+        ("pallas-ref-race", "Ref slice touched while a DMA is in flight (slot aliasing)"),
+        ("pallas-ring-neighbor", "remote device id not congruent mod axis size / self-send"),
+        ("pallas-block-misaligned", "gridded block splits a Mosaic tile or operand raggedly"),
         ("compile-unstubbed-test", "tier-1 test reaches a real verifier materialization"),
         ("compile-duplicate-program", "two tier-1 modules materialize the same program key"),
         ("compile-whitelist-stale", "compile-guard whitelist entry covers no compiling test"),
@@ -90,6 +100,8 @@ def main(argv: List[str] = None) -> int:
                     help="skip the lock/race interleaving harness")
     ap.add_argument("--skip-compile-cost", action="store_true",
                     help="skip the compile-cost static audit of tests/")
+    ap.add_argument("--skip-pallas", action="store_true",
+                    help="skip the Pallas kernel verifier layer")
     ap.add_argument("--buckets", default="4,128",
                     help="comma-separated bucket sizes for the jaxpr audit")
     ap.add_argument("--no-trace-cache", action="store_true",
@@ -109,6 +121,7 @@ def main(argv: List[str] = None) -> int:
         with_lock_audit=not args.skip_lock_audit,
         trace_cache=not args.no_trace_cache,
         with_compile_cost=not args.skip_compile_cost,
+        with_pallas=not args.skip_pallas,
     )
     if args.json:
         print(json.dumps({"violations": to_dicts(violations)}, indent=2))
