@@ -261,6 +261,11 @@ class RestApiServer:
         r("GET", "/eth/v1/lodestar/health", self._lodestar_health)
         r("GET", "/eth/v1/lodestar/forensics", self._forensics)
         r("GET", "/eth/v1/lodestar/observatory", self._observatory)
+        # mesh observatory: on-demand profile windows (docs/observability.md
+        # §Mesh observatory) — arm a capture of N pool flushes, optionally
+        # wait, and fetch the merged host+device Chrome trace
+        r("POST", "/eth/v1/lodestar/profile", self._profile)
+        r("GET", "/eth/v1/lodestar/profile", self._profile_status)
 
     # -- node/peers + config namespaces ----------------------------------------
 
@@ -1111,6 +1116,59 @@ class RestApiServer:
                 "latency_buckets_s": list(SLO_LATENCY_BUCKETS_S),
             }
         }
+
+    async def _profile(self, pp, q, b):
+        """Arm a device-profile window bracketing the next ``?flushes=N``
+        BLS pool flushes (docs/observability.md §Mesh observatory).
+        Waits up to ``?wait_s`` (default 10) for the window to close;
+        ``?format=chrome`` then returns the merged host+device Chrome
+        trace itself (Perfetto-loadable, ``check_trace.py
+        --require-device`` clean), anything else the capture snapshot.
+        A capture is created on demand (jax.profiler-backed) unless the
+        CLI/tests already configured one — e.g. a stub-pool test injects
+        fake profiler hooks."""
+        from ..observatory import xprof
+
+        cap = xprof.get_capture()
+        if cap is None:
+            cap = xprof.configure_capture(metrics=self.metrics)
+        try:
+            flushes = int(q.get("flushes", 2))
+            wait_s = float(q.get("wait_s", 10.0))
+        except ValueError as e:
+            raise ApiError(400, f"bad profile query: {e}")
+        windows_before = cap.windows
+        cap.request_window(flushes)
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + max(0.0, wait_s)
+        while cap.windows == windows_before and loop.time() < deadline:
+            await asyncio.sleep(0.05)
+        if q.get("format") == "chrome":
+            last = cap.last_window()
+            if cap.windows == windows_before or last is None:
+                raise ApiError(
+                    504,
+                    "profile window still open (not enough pool flushes "
+                    "inside wait_s) and no prior window to return — "
+                    "retry with a longer ?wait_s or drive more traffic",
+                )
+            return (json.dumps(last["trace"]).encode(), "application/json")
+        return {"data": cap.snapshot()}
+
+    def _profile_status(self, pp, q, b):
+        """Capture state + last-window summary without arming anything
+        (``?format=chrome`` fetches the last merged trace)."""
+        from ..observatory import xprof
+
+        cap = xprof.get_capture()
+        if cap is None:
+            raise ApiError(404, "no profile capture configured")
+        if q.get("format") == "chrome":
+            last = cap.last_window()
+            if last is None:
+                raise ApiError(404, "no completed profile window yet")
+            return (json.dumps(last["trace"]).encode(), "application/json")
+        return {"data": cap.snapshot()}
 
     def _forensics(self, pp, q, b):
         """On-demand diagnostic bundle ('what are you doing right now'

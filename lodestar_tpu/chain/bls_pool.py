@@ -75,6 +75,7 @@ from ..crypto.bls.verifier import (
     VerificationDroppedError,
 )
 from ..forensics.journal import JOURNAL
+from ..observatory.xprof import notify_flush as _xprof_notify_flush
 from ..tracing import TRACER
 from ..utils.queue import JobItemQueue, QueueError, QueueType
 from ..utils.logger import get_logger
@@ -623,6 +624,11 @@ class BlsBatchPool:
             self._update_backpressure()
             self._publish_lane_gauges()
             self._publish_flush_metrics(busy, time.monotonic() - flush_t0, sets_done)
+            # profile-window flush boundary (observatory/xprof.py): a
+            # constant-time no-op until a capture is configured, and
+            # guaranteed non-raising — deliberately OUTSIDE the metrics
+            # guard so a metrics-less pool still drives windows
+            _xprof_notify_flush()
             if len(self._queue):
                 self._buffered_sets_changed()
 

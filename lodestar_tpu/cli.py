@@ -13,6 +13,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import sys
 from typing import Optional
 
@@ -201,9 +202,19 @@ def build_parser() -> argparse.ArgumentParser:
         )
         p.add_argument(
             "--jax-profile", default=None, metavar="DIR",
-            help="run jax.profiler around the (blocking) BLS warmup and "
-            "write the device profile to DIR — the XLA-level view the "
-            "span tracer sits above",
+            help="device-profile capture root (docs/observability.md "
+            "§Mesh observatory): jax.profiler brackets the (blocking) "
+            "BLS warmup AND a steady-state dispatch window "
+            "(--profile-window flushes, default 4), and the merged "
+            "host+device Chrome trace lands in DIR/merged_trace.json "
+            "on shutdown",
+        )
+        p.add_argument(
+            "--profile-window", type=int, default=0, metavar="N",
+            help="arm a device-profile window over the next N BLS pool "
+            "flushes at startup (0 = only on POST "
+            "/eth/v1/lodestar/profile; with --jax-profile the default "
+            "becomes 4)",
         )
         p.add_argument(
             "--forensics-dir", default=None, metavar="DIR",
@@ -426,6 +437,50 @@ def _configure_observatory(args, metrics=None, pool=None) -> None:
             devices=devices or None,
         )
         logger.info("device telemetry sampler on (every %.1fs)", interval)
+    _configure_profile(args, metrics=metrics)
+
+
+def _configure_profile(args, metrics=None) -> None:
+    """Steady-state profile-window bring-up (ISSUE 20: --jax-profile
+    used to bracket only the blocking warmup; the dispatch-time windows
+    it was blind to are the whole point).  --jax-profile alone arms a
+    default 4-flush window; --profile-window N overrides the count and
+    also works standalone (capture dir under the tmp default)."""
+    from .observatory import xprof
+
+    profile_dir = getattr(args, "jax_profile", None)
+    window = getattr(args, "profile_window", 0) or (4 if profile_dir else 0)
+    if not profile_dir and not window:
+        return
+    cap = xprof.get_capture()  # _make_verifier may have configured it
+    if cap is None:
+        cap = xprof.configure_capture(profile_dir=profile_dir, metrics=metrics)
+    else:
+        cap.metrics = metrics
+    if window:
+        cap.request_window(window)
+        logger.info(
+            "profile window armed: next %d pool flushes -> %s",
+            window, cap.profile_dir,
+        )
+
+
+def _finalize_profile(args) -> None:
+    """Shutdown twin of _dump_trace: close any still-open window and
+    write the merged host+device Chrome trace next to the profile data."""
+    if not (getattr(args, "jax_profile", None)
+            or getattr(args, "profile_window", 0)):
+        return
+    from .observatory import xprof
+
+    cap = xprof.get_capture()
+    if cap is None:
+        return
+    cap.wait_idle(timeout=10.0)
+    last = cap.finalize()
+    if last is not None:
+        path = cap.write_merged(os.path.join(cap.profile_dir, "merged_trace.json"))
+        logger.info("wrote merged host+device trace to %s", path)
 
 
 def _dump_trace(path) -> None:
@@ -514,19 +569,24 @@ def _make_verifier(args):
         )
         warm = getattr(args, "bls_warmup", "background")
         profile_dir = getattr(args, "jax_profile", None)
+        capture = None
+        if profile_dir:
+            # one ProfileCapture owns the whole session: the warmup
+            # window here, the steady-state dispatch window armed by
+            # _configure_observatory, and any POST .../profile windows —
+            # all merged against the span tracer's clock
+            from .observatory import xprof
+
+            capture = xprof.configure_capture(profile_dir=profile_dir)
         if load_only and warm != "off":
             # load-only warmup is seconds (deserialize, no compile) and
             # its degradation verdict decides the serving tier — block.
             # --jax-profile still brackets it: the deserialize path is
             # exactly what a restart profile should show
-            if profile_dir:
-                import jax
-
-                jax.profiler.start_trace(profile_dir)
-                try:
-                    dt = v.warmup(load_only=True)
-                finally:
-                    jax.profiler.stop_trace()
+            if capture is not None:
+                dt = capture.run_window(
+                    lambda: v.warmup(load_only=True), label="warmup-load"
+                )
             else:
                 dt = v.warmup(load_only=True)
             logger.info(
@@ -534,16 +594,10 @@ def _make_verifier(args):
                 "(fused=%s, native_only=%s)", len(buckets), dt, v.fused,
                 v._native_tier_only,
             )
-        elif profile_dir and warm != "off":
+        elif capture is not None and warm != "off":
             # device-level profile of the AOT compiles + first dispatches;
-            # forces blocking warmup so stop_trace() brackets real work
-            import jax
-
-            jax.profiler.start_trace(profile_dir)
-            try:
-                dt = v.warmup()
-            finally:
-                jax.profiler.stop_trace()
+            # forces blocking warmup so the window closes on real work
+            dt = capture.run_window(v.warmup, label="warmup")
             logger.info("bls AOT warmup under jax.profiler: %d buckets in "
                         "%.1fs -> %s", len(buckets), dt, profile_dir)
         elif warm == "blocking":
@@ -949,11 +1003,13 @@ def main(argv: Optional[list] = None) -> int:
             # synchronous write in the finally: a Ctrl-C on a forever
             # node (--slots 0) must still produce the trace artifact
             _dump_trace(getattr(args, "trace_dump", None))
+            _finalize_profile(args)
     if args.cmd == "beacon":
         try:
             return asyncio.run(run_beacon(args))
         finally:
             _dump_trace(getattr(args, "trace_dump", None))
+            _finalize_profile(args)
     if args.cmd == "validator":
         return asyncio.run(run_validator(args))
     if args.cmd == "lightclient":

@@ -19,6 +19,8 @@ and summarizes it):
       topology.json    device topology (only when a JAX backend is already
                        initialized — a crash path must never trigger
                        backend init)
+      profile.json     mesh-observatory capture state: open/last profile
+                       window, attribution summary, measured overhead
       config.json      argv, python/jax versions, LODESTAR*/JAX*/XLA env
 
 Every section is individually fault-isolated: a broken producer records
@@ -107,6 +109,22 @@ def _topology() -> Dict[str, Any]:
     return out
 
 
+def _profile_state() -> Dict[str, Any]:
+    """Mesh-observatory capture state (docs/observability.md §Mesh
+    observatory): whether a profile window is open, the last window's
+    summary (batch attribution + scaling loss), and the capture's
+    measured overhead — lazy import so a crash path never pays for (or
+    dies in) the observatory package."""
+    from ..observatory.xprof import get_capture
+
+    cap = get_capture()
+    if cap is None:
+        return {"configured": False}
+    out: Dict[str, Any] = {"configured": True}
+    out.update(cap.snapshot())
+    return out
+
+
 def _config() -> Dict[str, Any]:
     env = {
         k: v for k, v in sorted(os.environ.items())
@@ -171,6 +189,7 @@ def write_bundle(
         section("metrics.prom",
                 lambda p: open(p, "wb").write(metrics_registry.expose()))
     section("topology.json", lambda p: _write_json(p, _topology()))
+    section("profile.json", lambda p: _write_json(p, _profile_state()))
     section("config.json", lambda p: _write_json(p, _config()))
 
     manifest: Dict[str, Any] = {

@@ -263,6 +263,40 @@ class Metrics:
             "zero on a busy multi-device pool means big batches are "
             "fanning out per-device instead of using the whole mesh",
         )
+        # mesh observatory: profile-window attribution (ISSUE 20,
+        # docs/observability.md §Mesh observatory)
+        self.bls_mesh_overlap_ratio = r.gauge(
+            "lodestar_bls_mesh_overlap_ratio",
+            "fraction of device-busy (dispatch-window) time during which "
+            "the host was packing ANOTHER merged batch — 1.0 means the "
+            "pipeline fully hides host pack behind device compute, 0 "
+            "means the stages strictly alternate (attribution engine, "
+            "updated per profile window)",
+        )
+        self.bls_sharded_combine_seconds = r.histogram(
+            "lodestar_bls_sharded_combine_seconds",
+            "per-mesh-batch cross-chip collective (GT combine) seconds "
+            "attributed from profile-window device events inside the "
+            "dispatch window — the communication term of the "
+            "scaling-loss breakdown",
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1),
+        )
+        self.bls_pipeline_bubble_seconds = r.histogram(
+            "lodestar_bls_pipeline_bubble_seconds",
+            "per-merged-batch end-to-end seconds the six-way attribution "
+            "(queue/pack/device/combine/final_exp) could NOT explain — "
+            "scheduler idle between pipeline stages",
+            buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1),
+        )
+        self.bls_scaling_loss = r.gauge(
+            "lodestar_bls_scaling_loss",
+            "mesh scaling loss (1 - scaling efficiency) split by "
+            "component: communication (cross-chip collectives), "
+            "shard_imbalance (slowest vs mean shard), serial_host "
+            "(pack/final-exp the mesh waits on) — components sum to "
+            "the measured gap within tolerance",
+            labels=("component",),
+        )
         # chaos campaign & self-healing device pool (round 12, docs/chaos.md)
         self.bls_degrade_total = r.counter(
             "lodestar_bls_degrade_total",

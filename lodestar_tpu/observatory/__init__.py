@@ -17,6 +17,11 @@ import os
 import time
 
 from . import device_sampler as _device_sampler
+from .attribution import (
+    attribute_spans,
+    mesh_scaling_loss,
+    scaling_loss_breakdown,
+)
 from .compile_ledger import COMPILE_LEDGER, CompileLedger
 from .device_sampler import DeviceSampler, start_sampler, stop_sampler
 from .latency import (
@@ -25,17 +30,34 @@ from .latency import (
     cumulative_counts,
     nearest_rank,
 )
+from .xprof import (
+    DEVICE_PID_BASE,
+    ProfileCapture,
+    configure_capture,
+    get_capture,
+    notify_flush,
+    parse_profile_dir,
+)
 
 __all__ = [
     "COMPILE_LEDGER",
     "CompileLedger",
+    "DEVICE_PID_BASE",
     "DeviceSampler",
+    "ProfileCapture",
     "SLO_LATENCY_BUCKETS_S",
+    "attribute_spans",
     "bucket_percentile",
+    "configure_capture",
     "cumulative_counts",
+    "get_capture",
     "get_sampler",
+    "mesh_scaling_loss",
     "nearest_rank",
+    "notify_flush",
+    "parse_profile_dir",
     "process_age_s",
+    "scaling_loss_breakdown",
     "start_sampler",
     "stop_sampler",
 ]

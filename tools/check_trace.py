@@ -3,6 +3,7 @@
 
 Usage:
     python tools/check_trace.py TRACE.json [--require-pipeline [N]]
+                                [--require-device [TOL_US]]
 
 Checks (the subset of the Trace Event Format spec that chrome://tracing
 and Perfetto actually require to load a file):
@@ -37,6 +38,18 @@ via the replay, and must show >= 2 ``bls.dispatch`` attempts.  This is
 the acceptance gate for a ``--trace-dump`` dev-chain run;
 tests/test_tracing.py drives it in-process.
 
+``--require-device [TOL_US]`` validates a MERGED host+device dump (the
+mesh observatory's xprof output, docs/observability.md §Mesh
+observatory): device events must live in renumbered processes at
+``pid >= 1000`` (one ``process_name`` metadata event each — the
+profiler pid/tid convention after the merge), host spans must remain at
+pid 0, the dump must carry its clock mapping
+(``otherData.device_clock``: numeric ``offset_us``/``skew_us``/
+``tolerance_us``), the remapped device events must share the host
+clock (their window overlaps the host span window), and a recorded
+skew beyond tolerance (TOL_US overrides the dump's own) fails — a
+merge whose clocks drifted is two timelines glued together, not one.
+
 Exit 0 on success; exit 1 with one error per line on failure.
 """
 
@@ -59,6 +72,11 @@ SHED_SPAN = "bls.shed"
 #: no re-dispatch means the recovery path lost the batch)
 REQUEUE_SPAN = "bls.requeue"
 _TS_PHASES = {"X", "B", "E", "i", "I"}
+#: merged-trace device processes start here (the
+#: lodestar_tpu/observatory/xprof.py DEVICE_PID_BASE convention; the
+#: value is duplicated so this tool stays runnable with no package
+#: on the path)
+DEVICE_PID_BASE = 1000
 
 
 def validate(trace: Any) -> List[str]:
@@ -209,6 +227,109 @@ def validate_pipeline(trace: Any, min_batches: int = 2) -> List[str]:
     return errors
 
 
+def validate_device_merge(trace: Any, tolerance_us: float = None) -> List[str]:
+    """Merged host+device dump errors (empty list = valid merge).
+
+    Requires: object form with ``otherData.device_clock`` (numeric
+    offset/skew/tolerance), >= 1 complete device event at
+    ``pid >= DEVICE_PID_BASE`` with a ``process_name`` metadata event
+    per device process, host spans still at pid 0, the remapped device
+    window overlapping the host window (shared clock), and
+    ``|skew_us| <= tolerance`` (``tolerance_us`` overrides the dump's)."""
+    errors: List[str] = []
+    if not isinstance(trace, dict):
+        return ["device-merge: merged dumps must use the object form "
+                "(otherData carries the clock mapping)"]
+    clock = (trace.get("otherData") or {}).get("device_clock")
+    if not isinstance(clock, dict):
+        return ["device-merge: otherData.device_clock missing — a merged "
+                "dump must record how the profiler timebase was mapped"]
+    for key in ("offset_us", "skew_us", "tolerance_us"):
+        if not isinstance(clock.get(key), (int, float)):
+            errors.append(
+                f"device-merge: device_clock.{key} must be numeric, "
+                f"got {clock.get(key)!r}"
+            )
+    if errors:
+        return errors
+    tol = float(tolerance_us) if tolerance_us is not None else float(
+        clock["tolerance_us"]
+    )
+    if abs(float(clock["skew_us"])) > tol:
+        errors.append(
+            f"device-merge: clock skew {clock['skew_us']:.1f}us exceeds "
+            f"tolerance {tol:.1f}us — the device timeline cannot be "
+            f"trusted against the host spans"
+        )
+    events = trace.get("traceEvents") or []
+    named_pids = set()
+    device_windows: List[tuple] = []
+    host_windows: List[tuple] = []
+    device_pids = set()
+    for ev in events:
+        if not isinstance(ev, dict):
+            continue
+        pid = ev.get("pid")
+        if not isinstance(pid, int):
+            continue
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            named_pids.add(pid)
+            continue
+        if ev.get("ph") != "X":
+            continue
+        ts, dur = ev.get("ts"), ev.get("dur", 0)
+        if not isinstance(ts, (int, float)):
+            continue
+        window = (float(ts), float(ts) + float(dur or 0))
+        if pid >= DEVICE_PID_BASE:
+            device_pids.add(pid)
+            device_windows.append(window)
+        elif pid == 0:
+            host_windows.append(window)
+    if not device_windows:
+        errors.append(
+            f"device-merge: no complete device events at "
+            f"pid >= {DEVICE_PID_BASE} — the merge carried no profile"
+        )
+    if not host_windows:
+        errors.append(
+            "device-merge: no host spans at pid 0 — the merge lost the "
+            "span-tracer timeline"
+        )
+    for pid in sorted(device_pids):
+        if pid not in named_pids:
+            errors.append(
+                f"device-merge: device process {pid} has no process_name "
+                f"metadata event (the profiler pid convention)"
+            )
+    if device_windows and host_windows:
+        d0 = min(a for a, _ in device_windows)
+        d1 = max(b for _, b in device_windows)
+        h0 = min(a for a, _ in host_windows)
+        h1 = max(b for _, b in host_windows)
+        if d1 < h0 - tol or d0 > h1 + tol:
+            errors.append(
+                f"device-merge: remapped device window "
+                f"[{d0:.1f}, {d1:.1f}]us does not overlap the host window "
+                f"[{h0:.1f}, {h1:.1f}]us (±{tol:.1f}us) — the clocks were "
+                f"not actually shared"
+            )
+    return errors
+
+
+def _optional_float(argv: List[str], flag: str):
+    """(present, value|None) for a flag with an optional numeric arg."""
+    if flag not in argv:
+        return False, None
+    idx = argv.index(flag)
+    if idx + 1 < len(argv):
+        try:
+            return True, float(argv[idx + 1])
+        except ValueError:
+            pass
+    return True, None
+
+
 def main(argv: List[str]) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
@@ -220,6 +341,7 @@ def main(argv: List[str]) -> int:
         idx = argv.index("--require-pipeline")
         if idx + 1 < len(argv) and argv[idx + 1].isdigit():
             min_batches = int(argv[idx + 1])
+    require_device, device_tol = _optional_float(argv, "--require-device")
     try:
         with open(path) as f:
             trace = json.load(f)
@@ -229,6 +351,8 @@ def main(argv: List[str]) -> int:
     errors = validate(trace)
     if not errors and require_pipeline:
         errors = validate_pipeline(trace, min_batches)
+    if not errors and require_device:
+        errors = validate_device_merge(trace, tolerance_us=device_tol)
     for err in errors:
         print(f"{path}: {err}", file=sys.stderr)
     if not errors:
